@@ -1,0 +1,24 @@
+"""janus_tpu — a TPU-native framework for the Distributed Aggregation Protocol (DAP).
+
+A from-scratch re-design of the capabilities of the reference implementation
+(cjpatton/janus, a Rust DAP-09 aggregator; see SURVEY.md) built TPU-first:
+
+- ``janus_tpu.ops``      — device kernels: prime-field limb arithmetic, NTT,
+  Keccak/TurboSHAKE, batched over the report axis (JAX / Pallas).
+- ``janus_tpu.vdaf``     — the VDAF layer: a per-report pure-Python oracle
+  (spec semantics, the test oracle) and the batched TPU prepare engine
+  (the product).  Mirrors the surface Janus consumes from libprio-rs
+  (reference: core/src/vdaf.rs, SURVEY.md §2.8).
+- ``janus_tpu.models``   — VDAF instance registry + dispatch (the analog of
+  ``VdafInstance`` / ``vdaf_dispatch!``, reference core/src/vdaf.rs:65,517).
+- ``janus_tpu.parallel`` — device mesh / sharding of the report axis,
+  aggregate-share collectives.
+- ``janus_tpu.messages`` — DAP TLS-syntax wire format (reference messages/).
+- ``janus_tpu.core``     — HPKE, clocks, auth tokens, retries (reference core/).
+- ``janus_tpu.datastore``— transactional state layer ("the database is the
+  checkpoint", reference aggregator_core/).
+- ``janus_tpu.aggregator`` — protocol engine, HTTP handlers, daemons
+  (reference aggregator/).
+"""
+
+__version__ = "0.1.0"

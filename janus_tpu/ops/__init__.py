@@ -1,0 +1,7 @@
+"""Device kernels: prime-field limb arithmetic, NTT, Keccak — batched, TPU-first.
+
+TPUs have no native 64/128-bit integer units, so field elements are carried as
+uint32 limb arrays (trailing limb axis) and all modular arithmetic is built
+from 16x16->32 partial products on the VPU.  Everything here is shape-static,
+jit/vmap-friendly, and free of data-dependent control flow.
+"""

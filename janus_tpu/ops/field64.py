@@ -1,0 +1,377 @@
+"""Field64 (Goldilocks, p = 2^64 - 2^32 + 1) as vectorized uint32-limb JAX ops.
+
+Role in the framework: this is the arithmetic under every Prio3 Field64 VDAF
+(Prio3Count and the Prio3SumVecField64MultiproofHmacSha256Aes128 family the
+reference exposes in core/src/vdaf.rs:65-108; SURVEY.md §2.8).  The reference
+gets it from the `prio` crate's Field64; here it is re-designed for the TPU
+VPU: no 64-bit integers, no data-dependent branches, every op elementwise over
+arbitrarily-shaped batches.
+
+Representation: a Field64 array of logical shape S is a uint32 array of shape
+S + (2,), with [..., 0] = low 32 bits and [..., 1] = high 32 bits, always in
+canonical form (< p).  The Goldilocks structure (2^64 ≡ 2^32 - 1, 2^96 ≡ -1
+mod p) gives a branch-free 128->64 bit reduction.
+
+Tested bit-for-bit against janus_tpu.vdaf.field_ref.Field64 (pure Python).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+MODULUS = (1 << 64) - (1 << 32) + 1
+GEN_ORDER = 1 << 32
+GENERATOR = pow(7, (1 << 32) - 1, MODULUS)  # generator of the 2^32 subgroup
+
+_U32 = jnp.uint32
+_MASK16 = jnp.uint32(0xFFFF)
+P_LO = jnp.uint32(1)
+P_HI = jnp.uint32(0xFFFFFFFF)
+# x - p (mod 2^64) == x + (2^32 - 1): used for branch-free conditional reduce.
+_NEG_P_LO = jnp.uint32(0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# packing helpers (host side)
+# ---------------------------------------------------------------------------
+
+
+def pack(values) -> np.ndarray:
+    """Python ints / iterable -> uint32 limb array (shape + (2,))."""
+    arr = np.asarray(
+        [[v & 0xFFFFFFFF, (v >> 32) & 0xFFFFFFFF] for v in np.ravel(np.array(values, dtype=object))],
+        dtype=np.uint32,
+    )
+    shape = np.shape(np.array(values, dtype=object))
+    return arr.reshape(shape + (2,))
+
+
+def unpack(x) -> np.ndarray:
+    """uint32 limb array -> numpy object array of Python ints."""
+    x = np.asarray(x)
+    lo = x[..., 0].astype(object)
+    hi = x[..., 1].astype(object)
+    return lo + (hi << 32)
+
+
+def zeros(shape) -> jnp.ndarray:
+    return jnp.zeros(tuple(shape) + (2,), dtype=_U32)
+
+
+def ones(shape) -> jnp.ndarray:
+    z = np.zeros(tuple(shape) + (2,), dtype=np.uint32)
+    z[..., 0] = 1
+    return jnp.asarray(z)
+
+
+def const(value: int):
+    """A scalar field constant as a (2,) uint32 array."""
+    value %= MODULUS
+    return jnp.asarray(np.array([value & 0xFFFFFFFF, value >> 32], dtype=np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# 32/64-bit primitive ops (uint32 lanes, wrapping semantics)
+# ---------------------------------------------------------------------------
+
+
+def _mul32(a, b):
+    """Full 32x32 -> 64-bit product as (lo, hi) uint32, via 16-bit partials."""
+    a0 = a & _MASK16
+    a1 = a >> 16
+    b0 = b & _MASK16
+    b1 = b >> 16
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    mid = lh + hl
+    mid_carry = (mid < lh).astype(_U32)
+    lo = ll + ((mid & _MASK16) << 16)
+    lo_carry = (lo < ll).astype(_U32)
+    hi = hh + (mid >> 16) + (mid_carry << 16) + lo_carry
+    return lo, hi
+
+
+def _add64(alo, ahi, blo, bhi):
+    """64-bit add with carry-out: returns (lo, hi, carry)."""
+    lo = alo + blo
+    c0 = (lo < alo).astype(_U32)
+    hi1 = ahi + bhi
+    c1 = (hi1 < ahi).astype(_U32)
+    hi = hi1 + c0
+    c2 = (hi < hi1).astype(_U32)
+    return lo, hi, c1 | c2
+
+
+def _sub64(alo, ahi, blo, bhi):
+    """64-bit subtract with borrow-out: returns (lo, hi, borrow)."""
+    lo = alo - blo
+    b0 = (alo < blo).astype(_U32)
+    hi1 = ahi - bhi
+    b1 = (ahi < bhi).astype(_U32)
+    hi = hi1 - b0
+    b2 = (hi1 < b0).astype(_U32)
+    return lo, hi, b1 | b2
+
+
+def _geq_p(lo, hi):
+    """x >= p, elementwise (p = 2^64 - 2^32 + 1)."""
+    return (hi == P_HI) & (lo >= P_LO)
+
+
+def _cond_sub_p(lo, hi):
+    """Subtract p where x >= p (x < 2p assumed): branch-free."""
+    need = _geq_p(lo, hi)
+    # x - p (mod 2^64) = x + (2^32 - 1)
+    slo = lo + _NEG_P_LO
+    carry = (slo < lo).astype(_U32)
+    shi = hi + carry  # note: + 0 from high limb of (2^32-1)
+    return jnp.where(need, slo, lo), jnp.where(need, shi, hi)
+
+
+# ---------------------------------------------------------------------------
+# field ops (canonical in, canonical out)
+# ---------------------------------------------------------------------------
+
+
+def add(x, y):
+    lo, hi, carry = _add64(x[..., 0], x[..., 1], y[..., 0], y[..., 1])
+    # carry => x + y >= 2^64 ≡ 2^32 - 1 (mod p); adding it cannot re-carry
+    # because x + y < 2p < 2^65 - 2^33.
+    clo = lo + _NEG_P_LO
+    cc = (clo < lo).astype(_U32)
+    chi = hi + cc
+    lo = jnp.where(carry.astype(bool), clo, lo)
+    hi = jnp.where(carry.astype(bool), chi, hi)
+    lo, hi = _cond_sub_p(lo, hi)
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def sub(x, y):
+    lo, hi, borrow = _sub64(x[..., 0], x[..., 1], y[..., 0], y[..., 1])
+    # borrow => result wrapped by 2^64; subtract (2^32 - 1) to add p back.
+    blo = lo - _NEG_P_LO
+    bb = (lo < _NEG_P_LO).astype(_U32)
+    bhi = hi - bb
+    lo = jnp.where(borrow.astype(bool), blo, lo)
+    hi = jnp.where(borrow.astype(bool), bhi, hi)
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def neg(x):
+    return sub(zeros(x.shape[:-1]), x)
+
+
+def _reduce128(w0, w1, w2, w3):
+    """Reduce a 128-bit value (w0 lowest limb) to canonical Field64.
+
+    Uses 2^64 ≡ 2^32 - 1 and 2^96 ≡ -1 (mod p):
+        x ≡ (w1w0) - w3 + w2 * (2^32 - 1).
+    """
+    # t = lo64 - w3  (w3 < 2^32)
+    tlo, thi, borrow = _sub64(w0, w1, w3, jnp.zeros_like(w3))
+    # on borrow the wrapped value is desired + (2^32 - 1) mod p: subtract it.
+    blo = tlo - _NEG_P_LO
+    bb = (tlo < _NEG_P_LO).astype(_U32)
+    bhi = thi - bb
+    tlo = jnp.where(borrow.astype(bool), blo, tlo)
+    thi = jnp.where(borrow.astype(bool), bhi, thi)
+    # u = w2 * (2^32 - 1) = (w2 << 32) - w2, as exact 64-bit value
+    ulo, uhi, _ = _sub64(jnp.zeros_like(w2), w2, w2, jnp.zeros_like(w2))
+    # r = t + u, with carry folded in as + (2^32 - 1) (cannot re-carry)
+    rlo, rhi, carry = _add64(tlo, thi, ulo, uhi)
+    clo = rlo + _NEG_P_LO
+    cc = (clo < rlo).astype(_U32)
+    chi = rhi + cc
+    rlo = jnp.where(carry.astype(bool), clo, rlo)
+    rhi = jnp.where(carry.astype(bool), chi, rhi)
+    rlo, rhi = _cond_sub_p(rlo, rhi)
+    return jnp.stack([rlo, rhi], axis=-1)
+
+
+def mul(x, y):
+    xlo, xhi = x[..., 0], x[..., 1]
+    ylo, yhi = y[..., 0], y[..., 1]
+    p00l, p00h = _mul32(xlo, ylo)
+    p01l, p01h = _mul32(xlo, yhi)
+    p10l, p10h = _mul32(xhi, ylo)
+    p11l, p11h = _mul32(xhi, yhi)
+    # accumulate limbs: w = p00 + (p01 + p10) << 32 + p11 << 64
+    w0 = p00l
+    w1 = p00h + p01l
+    c1 = (w1 < p00h).astype(_U32)
+    w1b = w1 + p10l
+    c1b = (w1b < w1).astype(_U32)
+    w2 = p01h + p10h
+    c2 = (w2 < p01h).astype(_U32)
+    w2b = w2 + p11l
+    c2b = (w2b < w2).astype(_U32)
+    w2c = w2b + c1 + c1b  # c1 + c1b <= 2; cannot overflow past one more carry
+    c2c = (w2c < w2b).astype(_U32)
+    w3 = p11h + c2 + c2b + c2c
+    return _reduce128(w0, w1b, w2c, w3)
+
+
+def square(x):
+    return mul(x, x)
+
+
+def mul_const(x, value: int):
+    """Multiply by a compile-time scalar constant."""
+    c = const(value)
+    return mul(x, jnp.broadcast_to(c, x.shape))
+
+
+def pow_static(x, e: int):
+    """x ** e for a compile-time exponent (square-and-multiply, unrolled)."""
+    assert e >= 0
+    result = ones(x.shape[:-1])
+    base = x
+    while e:
+        if e & 1:
+            result = mul(result, base)
+        base = square(base)
+        e >>= 1
+    return result
+
+
+def inv(x):
+    """Multiplicative inverse (x != 0) via Fermat."""
+    return pow_static(x, MODULUS - 2)
+
+
+def eq(x, y):
+    return (x[..., 0] == y[..., 0]) & (x[..., 1] == y[..., 1])
+
+
+def is_zero(x):
+    return (x[..., 0] == 0) & (x[..., 1] == 0)
+
+
+def select(mask, x, y):
+    """Elementwise select: mask has the logical (limbless) shape."""
+    return jnp.where(mask[..., None], x, y)
+
+
+# ---------------------------------------------------------------------------
+# reductions / linear algebra
+# ---------------------------------------------------------------------------
+
+
+def sum_mod(x, axis: int = -1):
+    """Sum along a logical axis (axis indexes the logical shape, not limbs)."""
+    if axis < 0:
+        axis = x.ndim - 1 + axis  # logical rank = x.ndim - 1
+    assert 0 <= axis < x.ndim - 1, "axis indexes the logical shape, not the limb axis"
+    x = jnp.moveaxis(x, axis, 0)
+    n = x.shape[0]
+    # tree fold: pad to a power of two with zeros
+    m = 1
+    while m < n:
+        m *= 2
+    if m != n:
+        pad = jnp.zeros((m - n,) + x.shape[1:], dtype=x.dtype)
+        x = jnp.concatenate([x, pad], axis=0)
+    while x.shape[0] > 1:
+        half = x.shape[0] // 2
+        x = add(x[:half], x[half:])
+    return x[0]
+
+
+def dot(x, y, axis: int = -1):
+    """Inner product along a logical axis."""
+    return sum_mod(mul(x, y), axis=axis)
+
+
+def poly_eval(coeffs, x):
+    """Evaluate polynomial (coeffs along logical axis 0, low order first) at x.
+
+    coeffs: [n, ..., 2]; x: [..., 2] broadcastable to coeffs[0].  Horner with a
+    static unrolled loop (n is a compile-time shape).
+    """
+    n = coeffs.shape[0]
+    acc = coeffs[n - 1]
+    for i in range(n - 2, -1, -1):
+        acc = add(mul(acc, x), coeffs[i])
+    return acc
+
+
+def powers(x, n: int):
+    """[x^0, x^1, ..., x^(n-1)] stacked on a new leading axis."""
+    out = [ones(x.shape[:-1])]
+    for _ in range(n - 1):
+        out.append(mul(out[-1], x))
+    return jnp.stack(out, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# NTT (iterative Cooley-Tukey, static size, precomputed twiddles)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _bitrev(n: int) -> np.ndarray:
+    bits = n.bit_length() - 1
+    idx = np.arange(n)
+    rev = np.zeros(n, dtype=np.int64)
+    for b in range(bits):
+        rev |= ((idx >> b) & 1) << (bits - 1 - b)
+    return rev
+
+
+@functools.lru_cache(maxsize=None)
+def _twiddles(n: int, inverse: bool) -> tuple:
+    """Per-stage twiddle tables as uint32 limb arrays."""
+    w = pow(GENERATOR, GEN_ORDER // n, MODULUS)
+    if inverse:
+        w = pow(w, MODULUS - 2, MODULUS)
+    tables = []
+    m = 2
+    while m <= n:
+        wm = pow(w, n // m, MODULUS)
+        tw = [pow(wm, k, MODULUS) for k in range(m // 2)]
+        tables.append(pack(tw))
+        m *= 2
+    return tuple(tables)
+
+
+def _ntt_core(x, n: int, inverse: bool):
+    batch = x.shape[:-2]
+    x = x[..., _bitrev(n), :]
+    for stage, tw in enumerate(_twiddles(n, inverse)):
+        m = 2 << stage
+        half = m // 2
+        xr = x.reshape(batch + (n // m, 2, half, 2))
+        u = xr[..., 0, :, :]
+        v = mul(xr[..., 1, :, :], jnp.asarray(tw))
+        out = jnp.stack([add(u, v), sub(u, v)], axis=-3)
+        x = out.reshape(batch + (n, 2))
+    return x
+
+
+def ntt(coeffs, n: int | None = None):
+    """Forward NTT: coefficients -> evaluations at powers of the n-th root.
+
+    coeffs shape [..., k, 2] with k <= n; zero-padded to n.  Output natural
+    order [p(w^0), ..., p(w^(n-1))], matching field_ref.Field64.ntt.
+    """
+    k = coeffs.shape[-2]
+    if n is None:
+        n = k
+    assert n & (n - 1) == 0 and k <= n
+    if k < n:
+        pad = jnp.zeros(coeffs.shape[:-2] + (n - k, 2), dtype=coeffs.dtype)
+        coeffs = jnp.concatenate([coeffs, pad], axis=-2)
+    return _ntt_core(coeffs, n, inverse=False)
+
+
+def intt(evals):
+    """Inverse NTT: evaluations -> coefficients (scaled by 1/n)."""
+    n = evals.shape[-2]
+    assert n & (n - 1) == 0
+    x = _ntt_core(evals, n, inverse=True)
+    return mul_const(x, pow(n, MODULUS - 2, MODULUS))

@@ -1,0 +1,7 @@
+"""VDAF layer: spec-semantics Python oracle + batched TPU prepare engine.
+
+The oracle mirrors the libprio-rs surface Janus consumes (SURVEY.md §2.8;
+reference core/src/vdaf.rs): shard, ping-pong prepare topology, aggregate,
+unshard.  The TPU engine (janus_tpu.vdaf.batch) computes the same functions
+vmapped over thousands of reports at once.
+"""

@@ -1,0 +1,183 @@
+"""Pure-Python prime fields — the reference arithmetic for the VDAF oracle.
+
+These are the two NTT-friendly fields used by Prio3 (reference: the `prio`
+crate's Field64/Field128, consumed by Janus via core/src/vdaf.rs; see
+SURVEY.md §2.8).  Elements are Python ints in [0, MODULUS); vectors are
+lists of ints.  Encoding is little-endian fixed-width (TLS opaque).
+
+This module is the *oracle*: slow, obviously-correct host arithmetic that the
+JAX/TPU limb kernels in janus_tpu.ops are tested against bit-for-bit.
+"""
+
+from __future__ import annotations
+
+
+class Field:
+    """A prime field with a power-of-two multiplicative subgroup (for NTT)."""
+
+    MODULUS: int
+    ENCODED_SIZE: int  # bytes per element, little-endian
+    GEN_ORDER: int  # order of the NTT subgroup (power of two)
+    GENERATOR: int  # generator of that subgroup
+
+    @classmethod
+    def add(cls, a: int, b: int) -> int:
+        return (a + b) % cls.MODULUS
+
+    @classmethod
+    def sub(cls, a: int, b: int) -> int:
+        return (a - b) % cls.MODULUS
+
+    @classmethod
+    def mul(cls, a: int, b: int) -> int:
+        return (a * b) % cls.MODULUS
+
+    @classmethod
+    def neg(cls, a: int) -> int:
+        return (-a) % cls.MODULUS
+
+    @classmethod
+    def pow(cls, a: int, e: int) -> int:
+        return pow(a, e, cls.MODULUS)
+
+    @classmethod
+    def inv(cls, a: int) -> int:
+        return pow(a, cls.MODULUS - 2, cls.MODULUS)
+
+    # -- vectors ---------------------------------------------------------
+
+    @classmethod
+    def vec_add(cls, a: list[int], b: list[int]) -> list[int]:
+        assert len(a) == len(b)
+        return [(x + y) % cls.MODULUS for x, y in zip(a, b)]
+
+    @classmethod
+    def vec_sub(cls, a: list[int], b: list[int]) -> list[int]:
+        assert len(a) == len(b)
+        return [(x - y) % cls.MODULUS for x, y in zip(a, b)]
+
+    @classmethod
+    def vec_neg(cls, a: list[int]) -> list[int]:
+        return [(-x) % cls.MODULUS for x in a]
+
+    @classmethod
+    def dot(cls, a: list[int], b: list[int]) -> int:
+        assert len(a) == len(b)
+        return sum(x * y for x, y in zip(a, b)) % cls.MODULUS
+
+    # -- codec -----------------------------------------------------------
+
+    @classmethod
+    def encode_vec(cls, vec: list[int]) -> bytes:
+        return b"".join(x.to_bytes(cls.ENCODED_SIZE, "little") for x in vec)
+
+    @classmethod
+    def decode_vec(cls, data: bytes) -> list[int]:
+        n = cls.ENCODED_SIZE
+        if len(data) % n != 0:
+            raise ValueError("field vector encoding has trailing bytes")
+        out = []
+        for i in range(0, len(data), n):
+            x = int.from_bytes(data[i : i + n], "little")
+            if x >= cls.MODULUS:
+                raise ValueError("field element out of range")
+            out.append(x)
+        return out
+
+    # -- polynomials (coefficient vectors, index i = coefficient of x^i) --
+
+    @classmethod
+    def poly_eval(cls, coeffs: list[int], x: int) -> int:
+        y = 0
+        for c in reversed(coeffs):
+            y = (y * x + c) % cls.MODULUS
+        return y
+
+    @classmethod
+    def poly_mul(cls, a: list[int], b: list[int]) -> list[int]:
+        out = [0] * (len(a) + len(b) - 1)
+        for i, x in enumerate(a):
+            if x == 0:
+                continue
+            for j, y in enumerate(b):
+                out[i + j] = (out[i + j] + x * y) % cls.MODULUS
+        return out
+
+    @classmethod
+    def poly_add(cls, a: list[int], b: list[int]) -> list[int]:
+        n = max(len(a), len(b))
+        a = a + [0] * (n - len(a))
+        b = b + [0] * (n - len(b))
+        return [(x + y) % cls.MODULUS for x, y in zip(a, b)]
+
+    # -- NTT over the 2^k subgroup ---------------------------------------
+
+    @classmethod
+    def root_of_unity(cls, n: int) -> int:
+        """Primitive n-th root of unity; n must be a power of two <= GEN_ORDER."""
+        assert n & (n - 1) == 0 and 0 < n <= cls.GEN_ORDER
+        return pow(cls.GENERATOR, cls.GEN_ORDER // n, cls.MODULUS)
+
+    @classmethod
+    def ntt(cls, coeffs: list[int], n: int | None = None) -> list[int]:
+        """Evaluate polynomial at the n powers of the n-th root of unity.
+
+        Output order: [p(w^0), p(w^1), ..., p(w^(n-1))] (natural order).
+        """
+        if n is None:
+            n = len(coeffs)
+        assert n & (n - 1) == 0
+        coeffs = coeffs[:n] + [0] * (n - len(coeffs))
+        w = cls.root_of_unity(n)
+        return cls._ntt_rec(coeffs, w)
+
+    @classmethod
+    def _ntt_rec(cls, a: list[int], w: int) -> list[int]:
+        n = len(a)
+        if n == 1:
+            return a
+        even = cls._ntt_rec(a[0::2], (w * w) % cls.MODULUS)
+        odd = cls._ntt_rec(a[1::2], (w * w) % cls.MODULUS)
+        out = [0] * n
+        wk = 1
+        for k in range(n // 2):
+            t = (wk * odd[k]) % cls.MODULUS
+            out[k] = (even[k] + t) % cls.MODULUS
+            out[k + n // 2] = (even[k] - t) % cls.MODULUS
+            wk = (wk * w) % cls.MODULUS
+        return out
+
+    @classmethod
+    def intt(cls, evals: list[int]) -> list[int]:
+        """Inverse NTT: interpolate coefficients from evaluations at w^i."""
+        n = len(evals)
+        w = cls.root_of_unity(n)
+        inv_w = cls.inv(w)
+        coeffs = cls._ntt_rec(list(evals), inv_w)
+        inv_n = cls.inv(n)
+        return [(c * inv_n) % cls.MODULUS for c in coeffs]
+
+
+class Field64(Field):
+    """The Goldilocks prime 2^64 - 2^32 + 1 (prio Field64)."""
+
+    MODULUS = (1 << 64) - (1 << 32) + 1
+    ENCODED_SIZE = 8
+    GEN_ORDER = 1 << 32
+    GENERATOR = pow(7, (1 << 32) - 1, MODULUS)
+
+
+class Field128(Field):
+    """The 128-bit VDAF field 2^66 * 4611686018427387897 + 1 (prio Field128).
+
+    Verified: MODULUS is prime, MODULUS - 1 = 2^66 * 3 * 3491 * 440340496364689,
+    and 7 is a primitive root, so GENERATOR has exact order 2^66.
+    """
+
+    MODULUS = 340282366920938462946865773367900766209
+    ENCODED_SIZE = 16
+    GEN_ORDER = 1 << 66
+    GENERATOR = pow(7, (MODULUS - 1) >> 66, MODULUS)
+
+
+FIELDS = {"Field64": Field64, "Field128": Field128}

@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The ambient environment registers the axon TPU tunnel as the default JAX
+platform via sitecustomize *before* conftest runs (and it force-updates
+``jax_platforms``), so plain env vars are not enough: we update the JAX
+config and drop any already-initialized backends.  Eager test traffic over
+the TPU tunnel is pathologically slow; tests always run on host CPU, with
+8 virtual devices for sharding tests (per the project environment contract).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+except Exception:  # pragma: no cover - best effort against older jax
+    pass

@@ -1,0 +1,140 @@
+"""Bit-for-bit tests of the JAX Field64 limb kernels vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+
+from janus_tpu.ops import field64 as f64
+from janus_tpu.vdaf.field_ref import Field64
+
+P = Field64.MODULUS
+rng = random.Random(0xC0FFEE)
+
+
+def rand_vec(n, edge_bias=True):
+    out = []
+    edge = [0, 1, 2, P - 1, P - 2, (1 << 32) - 1, 1 << 32, (1 << 63), P - (1 << 32)]
+    for i in range(n):
+        if edge_bias and i < len(edge):
+            out.append(edge[i])
+        else:
+            out.append(rng.randrange(P))
+    return out
+
+
+def test_pack_roundtrip():
+    xs = rand_vec(50)
+    assert list(f64.unpack(f64.pack(xs))) == xs
+
+
+def test_add_sub_neg():
+    xs, ys = rand_vec(200), rand_vec(200, edge_bias=False)
+    ys = ys[:9] + [0, 1, P - 1, P - 2] + ys[13:]
+    X, Y = f64.pack(xs), f64.pack(ys)
+    assert list(f64.unpack(f64.add(X, Y))) == Field64.vec_add(xs, ys)
+    assert list(f64.unpack(f64.sub(X, Y))) == Field64.vec_sub(xs, ys)
+    assert list(f64.unpack(f64.neg(X))) == Field64.vec_neg(xs)
+
+
+def test_mul():
+    xs, ys = rand_vec(300), list(reversed(rand_vec(300)))
+    X, Y = f64.pack(xs), f64.pack(ys)
+    expect = [Field64.mul(a, b) for a, b in zip(xs, ys)]
+    assert list(f64.unpack(f64.mul(X, Y))) == expect
+
+
+def test_pow_inv():
+    xs = [x for x in rand_vec(40) if x != 0]
+    X = f64.pack(xs)
+    for e in (0, 1, 2, 3, 7, 65537):
+        expect = [pow(x, e, P) for x in xs]
+        assert list(f64.unpack(f64.pow_static(X, e))) == expect
+    invs = f64.unpack(f64.inv(X))
+    assert list(invs) == [Field64.inv(x) for x in xs]
+
+
+def test_sum_dot():
+    xs, ys = rand_vec(37), rand_vec(37, edge_bias=False)
+    X, Y = f64.pack(xs), f64.pack(ys)
+    assert int(f64.unpack(f64.sum_mod(X, axis=0))) == sum(xs) % P
+    assert int(f64.unpack(f64.dot(X, Y, axis=0))) == Field64.dot(xs, ys)
+
+
+def test_sum_axis():
+    xs = [rand_vec(13, edge_bias=False) for _ in range(5)]
+    X = f64.pack(xs)  # [5, 13, 2]
+    got = f64.unpack(f64.sum_mod(X, axis=1))
+    for i in range(5):
+        assert int(got[i]) == sum(xs[i]) % P
+    got0 = f64.unpack(f64.sum_mod(X, axis=0))
+    for j in range(13):
+        assert int(got0[j]) == sum(row[j] for row in xs) % P
+
+
+def test_poly_eval():
+    coeffs = rand_vec(9)
+    pts = rand_vec(6, edge_bias=False)
+    C = f64.pack(coeffs)[:, None, :]  # [9, 1, 2] broadcast over points
+    Xs = f64.pack(pts)
+    got = f64.unpack(f64.poly_eval(jnp_broadcast(C, 9, 6), Xs))
+    assert [int(g) for g in got] == [Field64.poly_eval(coeffs, x) for x in pts]
+
+
+def jnp_broadcast(c, n, m):
+    import jax.numpy as jnp
+
+    return jnp.broadcast_to(c, (n, m, 2))
+
+
+def test_powers():
+    x = rand_vec(1, edge_bias=False)[0]
+    X = f64.pack([x])
+    got = f64.unpack(f64.powers(X, 8))
+    assert [int(g[0]) for g in got] == [pow(x, k, P) for k in range(8)]
+
+
+def test_ntt_matches_reference():
+    for n in (1, 2, 8, 64):
+        coeffs = rand_vec(n, edge_bias=False)
+        got = list(f64.unpack(f64.ntt(f64.pack(coeffs))))
+        assert got == Field64.ntt(coeffs)
+
+
+def test_ntt_zero_pad():
+    coeffs = rand_vec(5, edge_bias=False)
+    got = list(f64.unpack(f64.ntt(f64.pack(coeffs), n=8)))
+    assert got == Field64.ntt(coeffs, 8)
+
+
+def test_intt_roundtrip():
+    for n in (2, 16, 128):
+        coeffs = rand_vec(n, edge_bias=False)
+        evals = f64.ntt(f64.pack(coeffs))
+        back = list(f64.unpack(f64.intt(evals)))
+        assert back == coeffs
+        # and against the reference intt
+        assert Field64.intt(Field64.ntt(coeffs)) == coeffs
+
+
+def test_batched_ntt():
+    batch = [rand_vec(16, edge_bias=False) for _ in range(3)]
+    X = f64.pack(batch)  # [3, 16, 2]
+    got = f64.unpack(f64.ntt(X))
+    for i in range(3):
+        assert [int(v) for v in got[i]] == Field64.ntt(batch[i])
+
+
+def test_constants():
+    assert f64.GENERATOR == Field64.GENERATOR
+    assert pow(Field64.GENERATOR, Field64.GEN_ORDER, P) == 1
+    assert pow(Field64.GENERATOR, Field64.GEN_ORDER // 2, P) == P - 1
+
+
+def test_select_eq():
+    xs, ys = rand_vec(10), rand_vec(10, edge_bias=False)
+    X, Y = f64.pack(xs), f64.pack(ys)
+    m = np.asarray(f64.eq(X, X))
+    assert m.all()
+    sel = f64.select(f64.is_zero(X), Y, X)
+    expect = [y if x == 0 else x for x, y in zip(xs, ys)]
+    assert list(f64.unpack(sel)) == expect
