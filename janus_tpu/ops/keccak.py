@@ -1,0 +1,182 @@
+"""Batched Keccak-p[1600] / TurboSHAKE128 as uint32-lane-pair JAX ops.
+
+The XOF hot path of the framework: every report's joint-randomness derivation,
+share expansion, and query-randomness stream is a TurboSHAKE128 sponge
+(reference: prio 0.16's XofTurboShake128, core/src/vdaf.rs:16; SURVEY.md §2.8,
+§3.2).  Where the reference runs one sequential sponge per report, this module
+runs the permutation across an arbitrary batch of states at once.
+
+Design notes (TPU/XLA-first):
+- A state is a uint32 array of shape [..., 25, 2] ([..., i, 0] = low 32 bits
+  of lane i).  The round body is ~20 *vector* ops over the lane axis (theta as
+  an XOR-reduction + roll, rho as per-lane tensor shifts, pi as one static
+  gather, chi as rolls) — not 3600 scalar ops; an unrolled scalar formulation
+  sent XLA:CPU compile time past 3 minutes.
+- Rounds run under lax.scan with the round constants as the scanned operand:
+  one compiled body regardless of 12 vs 24 rounds.
+- Keccak lanes are little-endian u64, so a canonical Field64 limb pair
+  (lo, hi) *is* a lane — field data enters the sponge with no byte shuffling.
+
+Validated bit-for-bit against janus_tpu.vdaf.keccak_ref (which is itself
+validated against hashlib's SHAKE128 and the TurboSHAKE128 KAT).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from janus_tpu.vdaf.keccak_ref import ROTATION_OFFSETS, ROUND_CONSTANTS
+
+RATE_BYTES = 168
+RATE_LANES = 21
+
+_U32 = jnp.uint32
+
+# pi step as a single gather: OUT[dst] = IN[_PI_SRC[dst]]
+_PI_SRC = np.zeros(25, dtype=np.int32)
+for _x in range(5):
+    for _y in range(5):
+        _PI_SRC[_y + 5 * ((2 * _x + 3 * _y) % 5)] = _x + 5 * _y
+
+_RC_LIMBS = np.array(
+    [[rc & 0xFFFFFFFF, rc >> 32] for rc in ROUND_CONSTANTS], dtype=np.uint32
+)
+
+# per-lane rho rotations, applied post-pi-gather would differ; we rotate at rho
+# time with the offsets in source-lane order.
+_RHO = np.array(ROTATION_OFFSETS, dtype=np.uint32)
+
+
+def _rotl_by(lo, hi, n):
+    """Rotate-left (lo, hi) u64 lanes by per-lane amounts n (uint32 array, 0..63)."""
+    swap = (n & 32).astype(bool)
+    r = n & 31
+    a = jnp.where(swap, hi, lo)
+    b = jnp.where(swap, lo, hi)
+    # (a, b) rotated left by r within each 32-bit half-pair:
+    # new_lo = a << r | b >> (32 - r), new_hi = b << r | a >> (32 - r)
+    # guard r == 0 (shift by 32 is undefined): contribution is 0 there.
+    rs = jnp.where(r == 0, _U32(0), _U32(32) - r)
+    carry_b = jnp.where(r == 0, _U32(0), b >> rs)
+    carry_a = jnp.where(r == 0, _U32(0), a >> rs)
+    return (a << r) | carry_b, (b << r) | carry_a
+
+
+def _round(state, rc):
+    """One Keccak round on [..., 25, 2]; rc is a (2,) uint32 limb pair."""
+    lo, hi = state[..., 0], state[..., 1]  # [..., 25]
+    sh = lo.shape[:-1]
+    lo5 = lo.reshape(sh + (5, 5))  # [..., y, x]
+    hi5 = hi.reshape(sh + (5, 5))
+    # theta
+    clo = jax.lax.reduce(lo5, _U32(0), jax.lax.bitwise_xor, [lo5.ndim - 2])  # [..., x]
+    chi = jax.lax.reduce(hi5, _U32(0), jax.lax.bitwise_xor, [hi5.ndim - 2])
+    rlo, rhi = _rotl_by(jnp.roll(clo, -1, axis=-1), jnp.roll(chi, -1, axis=-1), _U32(1))
+    dlo = jnp.roll(clo, 1, axis=-1) ^ rlo
+    dhi = jnp.roll(chi, 1, axis=-1) ^ rhi
+    lo5 = lo5 ^ dlo[..., None, :]
+    hi5 = hi5 ^ dhi[..., None, :]
+    lo = lo5.reshape(sh + (25,))
+    hi = hi5.reshape(sh + (25,))
+    # rho (per-lane static rotation) then pi (static gather)
+    lo, hi = _rotl_by(lo, hi, jnp.asarray(_RHO))
+    lo = lo[..., _PI_SRC]
+    hi = hi[..., _PI_SRC]
+    # chi: a[x] = b[x] ^ (~b[x+1] & b[x+2]) along the x axis
+    lo5 = lo.reshape(sh + (5, 5))
+    hi5 = hi.reshape(sh + (5, 5))
+    lo5 = lo5 ^ (~jnp.roll(lo5, -1, axis=-1) & jnp.roll(lo5, -2, axis=-1))
+    hi5 = hi5 ^ (~jnp.roll(hi5, -1, axis=-1) & jnp.roll(hi5, -2, axis=-1))
+    lo = lo5.reshape(sh + (25,))
+    hi = hi5.reshape(sh + (25,))
+    # iota
+    lo = lo.at[..., 0].set(lo[..., 0] ^ rc[0])
+    hi = hi.at[..., 0].set(hi[..., 0] ^ rc[1])
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def permute(state, rounds: int = 12):
+    """Keccak-p[1600, rounds] on a batch of states [..., 25, 2] (last rounds of f[1600])."""
+    assert 1 <= rounds <= 24, "Keccak-p[1600] round count must be in [1, 24]"
+    rcs = jnp.asarray(_RC_LIMBS[24 - rounds :])
+
+    def step(st, rc):
+        return _round(st, rc), None
+
+    state, _ = jax.lax.scan(step, state, rcs)
+    return state
+
+
+def absorb(blocks, rounds: int = 12):
+    """Absorb pre-padded rate-lane blocks: [..., nblocks, 21, 2] -> state [..., 25, 2].
+
+    Uses lax.scan over the block axis so long messages (e.g. joint-rand binders
+    over encoded measurement shares) compile to a single rolled loop.
+    """
+    batch_shape = blocks.shape[:-3]
+    nblocks = blocks.shape[-3]
+    state = jnp.zeros(batch_shape + (25, 2), dtype=_U32)
+    if nblocks == 1:
+        # common case (short messages): avoid scan overhead
+        return permute(_xor_block(state, blocks[..., 0, :, :]), rounds)
+
+    def step(st, blk):
+        return permute(_xor_block(st, blk), rounds), None
+
+    # move block axis to front for scan
+    blocks_t = jnp.moveaxis(blocks, -3, 0)
+    state, _ = jax.lax.scan(step, state, blocks_t)
+    return state
+
+
+def _xor_block(state, block):
+    """XOR a 21-lane block into the first 21 lanes of the state."""
+    pad = jnp.zeros(block.shape[:-2] + (25 - RATE_LANES, 2), dtype=_U32)
+    return state ^ jnp.concatenate([block, pad], axis=-2)
+
+
+def squeeze(state, n_lanes: int, rounds: int = 12):
+    """Squeeze n_lanes 64-bit lanes: returns ([..., n_lanes, 2], next_state).
+
+    n_lanes is static; output lanes are the rate lanes of successive states.
+    next_state is advanced past the last (fully or partially) consumed block,
+    so a subsequent squeeze yields the *following* block's lanes.  If
+    n_lanes % RATE_LANES != 0 the unread tail of the last block is skipped —
+    callers needing exact byte-stream resumption must track their own offset
+    (the vdaf XOF layer squeezes whole streams in one call).
+    """
+    outs = []
+    remaining = n_lanes
+    while True:
+        take = min(remaining, RATE_LANES)
+        outs.append(state[..., :take, :])
+        remaining -= take
+        state = permute(state, rounds)
+        if remaining == 0:
+            break
+    return jnp.concatenate(outs, axis=-2) if len(outs) > 1 else outs[0], state
+
+
+def pad_message_to_blocks(message: bytes, domain: int):
+    """Host-side: byte message -> padded rate-lane blocks [nblocks, 21, 2] (numpy).
+
+    Applies the TurboSHAKE byte-aligned pad10*1 (domain byte carries the first
+    pad bit).  For device-resident message content, the vdaf layer builds the
+    same layout directly from limb arrays instead.
+    """
+    assert 0x01 <= domain <= 0x7F
+    p = bytearray(message)
+    p.append(domain)
+    if len(p) % RATE_BYTES:
+        p.extend(b"\x00" * (RATE_BYTES - len(p) % RATE_BYTES))
+    p[-1] ^= 0x80
+    nblocks = len(p) // RATE_BYTES
+    return np.frombuffer(bytes(p), dtype="<u4").reshape(nblocks, RATE_LANES, 2).copy()
+
+
+def lanes_to_bytes(lanes) -> bytes:
+    """Host-side: [n_lanes, 2] uint32 -> little-endian byte string."""
+    return np.ascontiguousarray(np.asarray(lanes), dtype="<u4").tobytes()

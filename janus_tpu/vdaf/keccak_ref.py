@@ -1,0 +1,119 @@
+"""Pure-Python Keccak-p[1600] / TurboSHAKE128 — oracle for the TPU kernels.
+
+TurboSHAKE128 (12-round Keccak-p, rate 168, domain byte in [0x01, 0x7f]) is the
+permutation under XofTurboShake128, the XOF used by every TurboShake128-keyed
+VDAF the reference dispatches (reference: prio 0.16 via core/src/vdaf.rs:16;
+SURVEY.md §2.8).  Round constants and rotation offsets are *derived* from the
+Keccak LFSR/positional definitions rather than transcribed, and the 24-round
+instance is validated against hashlib's SHAKE128 in tests.
+"""
+
+from __future__ import annotations
+
+_MASK64 = (1 << 64) - 1
+
+
+def _rc_bit(t: int) -> int:
+    """Keccak rc(t): LFSR x^8 + x^6 + x^5 + x^4 + 1 over GF(2)."""
+    if t % 255 == 0:
+        return 1
+    r = 1
+    for _ in range(t % 255):
+        r <<= 1
+        if r & 0x100:
+            r ^= 0x171
+    return r & 1
+
+
+def _round_constants() -> list[int]:
+    rcs = []
+    for ir in range(24):
+        rc = 0
+        for j in range(7):
+            if _rc_bit(j + 7 * ir):
+                rc |= 1 << ((1 << j) - 1)
+        rcs.append(rc)
+    return rcs
+
+
+def _rotation_offsets() -> list[int]:
+    """r[x + 5*y] per the rho step definition."""
+    offsets = [0] * 25
+    x, y = 1, 0
+    for t in range(24):
+        offsets[x + 5 * y] = ((t + 1) * (t + 2) // 2) % 64
+        x, y = y, (2 * x + 3 * y) % 5
+    return offsets
+
+
+ROUND_CONSTANTS = _round_constants()
+ROTATION_OFFSETS = _rotation_offsets()
+
+
+def _rotl(v: int, n: int) -> int:
+    n %= 64
+    return ((v << n) | (v >> (64 - n))) & _MASK64
+
+
+def permute(lanes: list[int], rounds: int = 24) -> list[int]:
+    """Keccak-p[1600, rounds]: the *last* `rounds` rounds of Keccak-f[1600].
+
+    lanes: 25 ints (64-bit), index x + 5*y.
+    """
+    assert 1 <= rounds <= 24, "Keccak-p[1600] round count must be in [1, 24]"
+    a = list(lanes)
+    for rc in ROUND_CONSTANTS[24 - rounds :]:
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        a = [a[i] ^ d[i % 5] for i in range(25)]
+        # rho + pi: b[y + 5*((2x + 3y) % 5)] = rotl(a[x + 5y], r[x + 5y])
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], ROTATION_OFFSETS[x + 5 * y])
+        # chi
+        a = [
+            b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y] & _MASK64)
+            for y in range(5)
+            for x in range(5)
+        ]
+        # iota
+        a[0] ^= rc
+    return a
+
+
+def _sponge(message: bytes, domain: int, rounds: int, rate: int, length: int) -> bytes:
+    """Keccak sponge with byte-aligned pad10*1; domain byte carries the first pad bit."""
+    assert 0x01 <= domain <= 0x7F
+    p = bytearray(message)
+    p.append(domain)
+    if len(p) % rate:
+        p.extend(b"\x00" * (rate - len(p) % rate))
+    p[-1] ^= 0x80
+    lanes = [0] * 25
+    for off in range(0, len(p), rate):
+        block = p[off : off + rate]
+        for i in range(rate // 8):
+            lanes[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        lanes = permute(lanes, rounds)
+    out = bytearray()
+    while len(out) < length:
+        for i in range(rate // 8):
+            out.extend(lanes[i].to_bytes(8, "little"))
+            if len(out) >= length:
+                break
+        if len(out) < length:
+            lanes = permute(lanes, rounds)
+    return bytes(out[:length])
+
+
+def turboshake128(message: bytes, domain: int, length: int) -> bytes:
+    """TurboSHAKE128: 12-round Keccak-p, rate 168."""
+    return _sponge(message, domain, rounds=12, rate=168, length=length)
+
+
+def shake128(message: bytes, length: int) -> bytes:
+    """Plain SHAKE128 (24 rounds, domain 0x1f) — used only to validate the
+    permutation/sponge against hashlib in tests."""
+    return _sponge(message, domain=0x1F, rounds=24, rate=168, length=length)
