@@ -1,0 +1,498 @@
+"""FLP (fully linear proof) system — Python oracle for the Prio3 circuits.
+
+This is the proof system under every Prio3 VDAF the reference dispatches
+(reference: prio 0.16's `flp` module, consumed via core/src/vdaf.rs:65-108;
+SURVEY.md §2.8): a prover commits to gadget wire polynomials interpolated over
+a power-of-two NTT subgroup, and verifiers holding additive shares of the
+measurement check a random evaluation point plus the circuit output, all with
+one round of interaction via the VDAF joint/query randomness.
+
+Structure (BBCGGI19 / VDAF spec semantics):
+- `prove`: run the validity circuit recording every gadget call; for each
+  gadget, interpolate wire polys over [seed, call inputs..., 0...] at the
+  subgroup; the proof is the wire seeds plus the composed gadget polynomial.
+- `query`: re-run the circuit on a share, taking gadget outputs from the
+  (shared) gadget polynomial at the call points; emit the circuit output
+  share, each wire poly evaluated at the query point t, and the gadget poly
+  at t.
+- `decide`: on the combined verifier, check circuit output == 0 and
+  G(wires(t)) == gadget_poly(t) per gadget.
+
+Convention notes (documented divergence risk; centralized so they are
+one-line changes): random linear combinations weight the i-th term by r^(i+1);
+Histogram appends one extra joint-rand element to combine its sum check with
+its range check.
+"""
+
+from __future__ import annotations
+
+from janus_tpu.vdaf.field_ref import Field, Field64, Field128
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# gadgets
+# ---------------------------------------------------------------------------
+
+
+class Gadget:
+    ARITY: int
+    DEGREE: int
+
+    def eval(self, field: type[Field], inputs: list[int]) -> int:
+        raise NotImplementedError
+
+    def eval_poly(self, field: type[Field], input_polys: list[list[int]]) -> list[int]:
+        """Compose the gadget over polynomial inputs (coefficient vectors)."""
+        raise NotImplementedError
+
+
+class Mul(Gadget):
+    ARITY = 2
+    DEGREE = 2
+
+    def eval(self, field, inputs):
+        return field.mul(inputs[0], inputs[1])
+
+    def eval_poly(self, field, input_polys):
+        return field.poly_mul(input_polys[0], input_polys[1])
+
+
+class PolyEval(Gadget):
+    """Evaluate a fixed univariate polynomial p at the single input wire."""
+
+    ARITY = 1
+
+    def __init__(self, coeffs: list[int]):
+        assert len(coeffs) >= 2
+        self.coeffs = coeffs
+        self.DEGREE = len(coeffs) - 1
+
+    def eval(self, field, inputs):
+        return field.poly_eval(self.coeffs, inputs[0])
+
+    def eval_poly(self, field, input_polys):
+        x = input_polys[0]
+        out = [self.coeffs[0]]
+        power = [1]
+        for c in self.coeffs[1:]:
+            power = field.poly_mul(power, x)
+            out = field.poly_add(out, [field.mul(c, v) for v in power])
+        return out
+
+
+class ParallelSum(Gadget):
+    """Sum of `count` applications of a subgadget to consecutive input chunks."""
+
+    def __init__(self, subgadget: Gadget, count: int):
+        self.subgadget = subgadget
+        self.count = count
+        self.ARITY = subgadget.ARITY * count
+        self.DEGREE = subgadget.DEGREE
+
+    def eval(self, field, inputs):
+        a = self.subgadget.ARITY
+        out = 0
+        for i in range(self.count):
+            out = field.add(out, self.subgadget.eval(field, inputs[i * a : (i + 1) * a]))
+        return out
+
+    def eval_poly(self, field, input_polys):
+        a = self.subgadget.ARITY
+        out = [0]
+        for i in range(self.count):
+            out = field.poly_add(out, self.subgadget.eval_poly(field, input_polys[i * a : (i + 1) * a]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# gadget call wrappers used during prove/query
+# ---------------------------------------------------------------------------
+
+
+class _RecordingGadget:
+    """Prover side: record call inputs, return the true gadget output."""
+
+    def __init__(self, field, gadget: Gadget):
+        self.field = field
+        self.gadget = gadget
+        self.calls: list[list[int]] = []
+
+    def __call__(self, inputs: list[int]) -> int:
+        assert len(inputs) == self.gadget.ARITY
+        self.calls.append(list(inputs))
+        return self.gadget.eval(self.field, inputs)
+
+
+class _QueryGadget:
+    """Verifier side: record call inputs, answer from the proof's gadget poly.
+
+    Call k (0-based) is answered with gadget_poly_share(alpha^(k+1)); slot
+    alpha^0 holds the wire seed.
+    """
+
+    def __init__(self, field, gadget: Gadget, poly_coeffs: list[int], p2: int):
+        self.field = field
+        self.gadget = gadget
+        self.coeffs = poly_coeffs
+        self.alpha = field.root_of_unity(p2)
+        self.calls: list[list[int]] = []
+        self._point = self.alpha  # alpha^(k+1) for k = 0, 1, ...
+
+    def __call__(self, inputs: list[int]) -> int:
+        assert len(inputs) == self.gadget.ARITY
+        self.calls.append(list(inputs))
+        out = self.field.poly_eval(self.coeffs, self._point)
+        self._point = self.field.mul(self._point, self.alpha)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# validity circuits
+# ---------------------------------------------------------------------------
+
+
+class Valid:
+    """A validity circuit: gadgets + an affine wiring, plus encode/truncate/decode."""
+
+    field: type[Field]
+    MEAS_LEN: int
+    JOINT_RAND_LEN: int
+    OUTPUT_LEN: int
+
+    def gadgets(self) -> list[Gadget]:
+        raise NotImplementedError
+
+    def gadget_calls(self) -> list[int]:
+        raise NotImplementedError
+
+    def eval(self, gadget_fns, meas: list[int], joint_rand: list[int], num_shares: int) -> int:
+        """Affine circuit over meas and gadget outputs; gadget_fns are callables."""
+        raise NotImplementedError
+
+    def encode(self, measurement) -> list[int]:
+        raise NotImplementedError
+
+    def truncate(self, meas: list[int]) -> list[int]:
+        raise NotImplementedError
+
+    def decode(self, output: list[int], num_measurements: int):
+        raise NotImplementedError
+
+
+class Count(Valid):
+    """Prio3Count: measurement in {0,1}; check x*x - x == 0.
+
+    Reference instance: VdafInstance::Prio3Count (core/src/vdaf.rs:66).
+    """
+
+    field = Field64
+    MEAS_LEN = 1
+    JOINT_RAND_LEN = 0
+    OUTPUT_LEN = 1
+
+    def gadgets(self):
+        return [Mul()]
+
+    def gadget_calls(self):
+        return [1]
+
+    def eval(self, gadget_fns, meas, joint_rand, num_shares):
+        (x,) = meas
+        return self.field.sub(gadget_fns[0]([x, x]), x)
+
+    def encode(self, measurement):
+        assert measurement in (0, 1)
+        return [measurement]
+
+    def truncate(self, meas):
+        return list(meas)
+
+    def decode(self, output, num_measurements):
+        return output[0]
+
+
+class Sum(Valid):
+    """Prio3Sum: measurement in [0, 2^bits); bit-decompose and range-check each bit.
+
+    Reference instance: VdafInstance::Prio3Sum { bits } (core/src/vdaf.rs:67).
+    """
+
+    def __init__(self, bits: int, field: type[Field] = Field128):
+        assert 0 < bits < field.MODULUS.bit_length()
+        self.field = field
+        self.bits = bits
+        self.MEAS_LEN = bits
+        self.JOINT_RAND_LEN = 1
+        self.OUTPUT_LEN = 1
+
+    def gadgets(self):
+        return [PolyEval([0, self.field.MODULUS - 1, 1])]  # x^2 - x
+
+    def gadget_calls(self):
+        return [self.bits]
+
+    def eval(self, gadget_fns, meas, joint_rand, num_shares):
+        f = self.field
+        out = 0
+        r = joint_rand[0]
+        w = r
+        for b in meas:
+            out = f.add(out, f.mul(w, gadget_fns[0]([b])))
+            w = f.mul(w, r)
+        return out
+
+    def encode(self, measurement):
+        assert 0 <= measurement < (1 << self.bits)
+        return [(measurement >> i) & 1 for i in range(self.bits)]
+
+    def truncate(self, meas):
+        f = self.field
+        out = 0
+        for i, b in enumerate(meas):
+            out = f.add(out, f.mul(1 << i, b))
+        return [out]
+
+    def decode(self, output, num_measurements):
+        return output[0]
+
+
+class SumVec(Valid):
+    """Prio3SumVec: vector of `length` values in [0, 2^bits); chunked range check.
+
+    Bits are checked via ParallelSum(Mul): each chunk contributes
+    sum_j Mul(r^(j+1) * b_j, b_j - 1/num_shares) with per-chunk joint rand r.
+    Reference instances: VdafInstance::Prio3SumVec and the Field64 multiproof
+    variant (core/src/vdaf.rs:68-86).
+    """
+
+    def __init__(self, length: int, bits: int, chunk_length: int, field: type[Field] = Field128):
+        assert length > 0 and bits > 0 and chunk_length > 0
+        self.field = field
+        self.length = length
+        self.bits = bits
+        self.chunk_length = chunk_length
+        self.MEAS_LEN = length * bits
+        self._calls = (self.MEAS_LEN + chunk_length - 1) // chunk_length
+        self.JOINT_RAND_LEN = self._calls
+        self.OUTPUT_LEN = length
+
+    def gadgets(self):
+        return [ParallelSum(Mul(), self.chunk_length)]
+
+    def gadget_calls(self):
+        return [self._calls]
+
+    def eval(self, gadget_fns, meas, joint_rand, num_shares):
+        f = self.field
+        shares_inv = f.inv(num_shares % f.MODULUS)
+        out = 0
+        for i in range(self._calls):
+            r = joint_rand[i]
+            inputs = []
+            w = r
+            for j in range(self.chunk_length):
+                idx = i * self.chunk_length + j
+                elem = meas[idx] if idx < self.MEAS_LEN else 0
+                inputs.append(f.mul(w, elem))
+                inputs.append(f.sub(elem, shares_inv))
+                w = f.mul(w, r)
+            out = f.add(out, gadget_fns[0](inputs))
+        return out
+
+    def encode(self, measurement):
+        assert len(measurement) == self.length
+        out = []
+        for v in measurement:
+            assert 0 <= v < (1 << self.bits)
+            out.extend((v >> i) & 1 for i in range(self.bits))
+        return out
+
+    def truncate(self, meas):
+        f = self.field
+        out = []
+        for k in range(self.length):
+            acc = 0
+            for i in range(self.bits):
+                acc = f.add(acc, f.mul(1 << i, meas[k * self.bits + i]))
+            out.append(acc)
+        return out
+
+    def decode(self, output, num_measurements):
+        return list(output)
+
+
+class Histogram(Valid):
+    """Prio3Histogram: one-hot vector of `length` buckets; chunked range check
+    plus a sum-to-one check combined with an extra joint-rand element.
+
+    Reference instance: VdafInstance::Prio3Histogram (core/src/vdaf.rs:87).
+    """
+
+    def __init__(self, length: int, chunk_length: int, field: type[Field] = Field128):
+        assert length > 0 and chunk_length > 0
+        self.field = field
+        self.length = length
+        self.chunk_length = chunk_length
+        self.MEAS_LEN = length
+        self._calls = (length + chunk_length - 1) // chunk_length
+        self.JOINT_RAND_LEN = self._calls + 1
+        self.OUTPUT_LEN = length
+
+    def gadgets(self):
+        return [ParallelSum(Mul(), self.chunk_length)]
+
+    def gadget_calls(self):
+        return [self._calls]
+
+    def eval(self, gadget_fns, meas, joint_rand, num_shares):
+        f = self.field
+        shares_inv = f.inv(num_shares % f.MODULUS)
+        range_check = 0
+        for i in range(self._calls):
+            r = joint_rand[i]
+            inputs = []
+            w = r
+            for j in range(self.chunk_length):
+                idx = i * self.chunk_length + j
+                elem = meas[idx] if idx < self.MEAS_LEN else 0
+                inputs.append(f.mul(w, elem))
+                inputs.append(f.sub(elem, shares_inv))
+                w = f.mul(w, r)
+            range_check = f.add(range_check, gadget_fns[0](inputs))
+        sum_check = f.neg(shares_inv)
+        for b in meas:
+            sum_check = f.add(sum_check, b)
+        return f.add(range_check, f.mul(joint_rand[self._calls], sum_check))
+
+    def encode(self, measurement):
+        assert 0 <= measurement < self.length
+        return [1 if i == measurement else 0 for i in range(self.length)]
+
+    def truncate(self, meas):
+        return list(meas)
+
+    def decode(self, output, num_measurements):
+        return list(output)
+
+
+# ---------------------------------------------------------------------------
+# the generic FLP
+# ---------------------------------------------------------------------------
+
+
+class FlpError(Exception):
+    pass
+
+
+class Flp:
+    """Generic FLP over a validity circuit."""
+
+    def __init__(self, valid: Valid):
+        self.valid = valid
+        self.field = valid.field
+        self.gadgets = valid.gadgets()
+        self.gadget_calls = valid.gadget_calls()
+        self.MEAS_LEN = valid.MEAS_LEN
+        self.JOINT_RAND_LEN = valid.JOINT_RAND_LEN
+        self.OUTPUT_LEN = valid.OUTPUT_LEN
+        self.PROVE_RAND_LEN = sum(g.ARITY for g in self.gadgets)
+        self.QUERY_RAND_LEN = len(self.gadgets)
+        self.VERIFIER_LEN = 1 + sum(g.ARITY + 1 for g in self.gadgets)
+        self.PROOF_LEN = 0
+        for g, m in zip(self.gadgets, self.gadget_calls):
+            p2 = next_pow2(m + 1)
+            self.PROOF_LEN += g.ARITY + g.DEGREE * (p2 - 1) + 1
+
+    # -- prover ----------------------------------------------------------
+
+    def prove(self, meas: list[int], prove_rand: list[int], joint_rand: list[int]) -> list[int]:
+        assert len(prove_rand) == self.PROVE_RAND_LEN
+        assert len(joint_rand) == self.JOINT_RAND_LEN
+        f = self.field
+        recorders = [_RecordingGadget(f, g) for g in self.gadgets]
+        self.valid.eval(recorders, meas, joint_rand, 1)
+        proof = []
+        seed_idx = 0
+        for g, m, rec in zip(self.gadgets, self.gadget_calls, recorders):
+            assert len(rec.calls) == m, f"circuit made {len(rec.calls)} calls, declared {m}"
+            p2 = next_pow2(m + 1)
+            seeds = prove_rand[seed_idx : seed_idx + g.ARITY]
+            seed_idx += g.ARITY
+            wire_polys = []
+            for wire in range(g.ARITY):
+                evals = [seeds[wire]] + [rec.calls[k][wire] for k in range(m)]
+                evals += [0] * (p2 - len(evals))
+                wire_polys.append(f.intt(evals))
+            gpoly = g.eval_poly(f, wire_polys)
+            want = g.DEGREE * (p2 - 1) + 1
+            gpoly = (gpoly + [0] * want)[:want]
+            proof.extend(seeds)
+            proof.extend(gpoly)
+        return proof
+
+    # -- verifier --------------------------------------------------------
+
+    def query(
+        self,
+        meas_share: list[int],
+        proof_share: list[int],
+        query_rand: list[int],
+        joint_rand: list[int],
+        num_shares: int,
+    ) -> list[int]:
+        assert len(proof_share) == self.PROOF_LEN
+        assert len(query_rand) == self.QUERY_RAND_LEN
+        assert len(joint_rand) == self.JOINT_RAND_LEN
+        f = self.field
+        # parse proof share and build query gadgets
+        qgadgets = []
+        seeds_per_gadget = []
+        idx = 0
+        for g, m in zip(self.gadgets, self.gadget_calls):
+            p2 = next_pow2(m + 1)
+            seeds = proof_share[idx : idx + g.ARITY]
+            idx += g.ARITY
+            ncoeffs = g.DEGREE * (p2 - 1) + 1
+            coeffs = proof_share[idx : idx + ncoeffs]
+            idx += ncoeffs
+            qgadgets.append(_QueryGadget(f, g, coeffs, p2))
+            seeds_per_gadget.append(seeds)
+        v = self.valid.eval(qgadgets, meas_share, joint_rand, num_shares)
+        verifier = [v]
+        for g, m, qg, seeds, t in zip(
+            self.gadgets, self.gadget_calls, qgadgets, seeds_per_gadget, query_rand
+        ):
+            assert len(qg.calls) == m
+            p2 = next_pow2(m + 1)
+            if f.pow(t, p2) == 1:
+                # t falls in the wire-interpolation domain: unusable query rand.
+                raise FlpError("query randomness lands in the evaluation domain")
+            for wire in range(g.ARITY):
+                evals = [seeds[wire]] + [qg.calls[k][wire] for k in range(m)]
+                evals += [0] * (p2 - len(evals))
+                wire_poly = f.intt(evals)
+                verifier.append(f.poly_eval(wire_poly, t))
+            verifier.append(f.poly_eval(qg.coeffs, t))
+        return verifier
+
+    def decide(self, verifier: list[int]) -> bool:
+        assert len(verifier) == self.VERIFIER_LEN
+        f = self.field
+        if verifier[0] != 0:
+            return False
+        idx = 1
+        for g in self.gadgets:
+            wires = verifier[idx : idx + g.ARITY]
+            idx += g.ARITY
+            y = verifier[idx]
+            idx += 1
+            if g.eval(f, wires) != y:
+                return False
+        return True

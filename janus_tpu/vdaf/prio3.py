@@ -1,0 +1,423 @@
+"""Prio3 — the VDAF composition over an FLP: Python per-report oracle.
+
+Mirrors the libprio-rs surface Janus consumes (SURVEY.md §2.8; reference
+core/src/vdaf.rs constructors at :178-195 and the ping-pong topology used at
+aggregator.rs:1947, aggregation_job_driver.rs:345):
+
+- ``shard(measurement, nonce, rand)`` -> (public_share, input_shares)
+- ``prep_init / prep_shares_to_prep / prep_next`` (one FLP round)
+- ``aggregate``, ``unshard``
+- byte codecs for every share/message type (DAP carries them opaquely)
+
+The TPU engine computes the same functions batched; this module is its
+bit-exactness oracle and the host-side fallback path.
+
+Domain separation: dst = VERSION byte || algorithm-class byte || algorithm id
+(u32 BE) || usage (u16 BE).  Usages: measurement share 1, proof share 2,
+joint randomness 3, prove randomness 4, query randomness 5, joint rand seed 6,
+joint rand part 7.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from janus_tpu.vdaf.flp import Flp, FlpError
+from janus_tpu.vdaf.xof import XofTurboShake128
+
+VERSION = 8  # VDAF draft version byte used in domain separation
+ALGO_CLASS_VDAF = 0
+
+USAGE_MEAS_SHARE = 1
+USAGE_PROOF_SHARE = 2
+USAGE_JOINT_RANDOMNESS = 3
+USAGE_PROVE_RANDOMNESS = 4
+USAGE_QUERY_RANDOMNESS = 5
+USAGE_JOINT_RAND_SEED = 6
+USAGE_JOINT_RAND_PART = 7
+
+# DAP algorithm ids (reference: prio 0.16; custom multiproof id at
+# core/src/vdaf.rs:20).
+ALGO_PRIO3_COUNT = 0x00000000
+ALGO_PRIO3_SUM = 0x00000001
+ALGO_PRIO3_SUM_VEC = 0x00000002
+ALGO_PRIO3_HISTOGRAM = 0x00000003
+ALGO_PRIO3_SUM_VEC_FIELD64_MULTIPROOF_HMAC = 0xFFFF1003
+
+NONCE_SIZE = 16
+
+
+class VdafError(Exception):
+    pass
+
+
+@dataclass
+class PrepState:
+    out_share: list[int]  # truncated measurement share (released on success)
+    joint_rand_seed: bytes | None  # corrected seed to cross-check
+
+
+@dataclass
+class PrepShare:
+    joint_rand_part: bytes | None
+    verifiers: list[int]  # PROOFS * VERIFIER_LEN elements
+
+
+@dataclass
+class PrepMessage:
+    joint_rand_seed: bytes | None
+
+
+class Prio3:
+    """A Prio3 instance: FLP + XOF + share count + proof count."""
+
+    ROUNDS = 1
+
+    def __init__(self, flp: Flp, algorithm_id: int, shares: int = 2, proofs: int = 1,
+                 xof=XofTurboShake128):
+        assert shares >= 2
+        assert proofs >= 1
+        self.flp = flp
+        self.field = flp.field
+        self.algorithm_id = algorithm_id
+        self.shares = shares
+        self.proofs = proofs
+        self.xof = xof
+        self.SEED_SIZE = xof.SEED_SIZE
+        self.has_joint_rand = flp.JOINT_RAND_LEN > 0
+        # rand consumed by shard: one seed per helper, plus (if joint rand)
+        # one blind per aggregator, plus the prove seed.
+        n_seeds = (shares - 1) + (shares if self.has_joint_rand else 0) + 1
+        self.RAND_SIZE = n_seeds * self.SEED_SIZE
+        self.VERIFY_KEY_SIZE = xof.SEED_SIZE
+
+    # -- domain separation ----------------------------------------------
+
+    def dst(self, usage: int) -> bytes:
+        return (
+            bytes([VERSION, ALGO_CLASS_VDAF])
+            + self.algorithm_id.to_bytes(4, "big")
+            + usage.to_bytes(2, "big")
+        )
+
+    # -- helpers ---------------------------------------------------------
+
+    def _helper_meas_share(self, seed: bytes, agg_id: int) -> list[int]:
+        return self.xof.expand_into_vec(
+            self.field, seed, self.dst(USAGE_MEAS_SHARE), bytes([agg_id]), self.flp.MEAS_LEN
+        )
+
+    def _helper_proofs_share(self, seed: bytes, agg_id: int) -> list[int]:
+        return self.xof.expand_into_vec(
+            self.field,
+            seed,
+            self.dst(USAGE_PROOF_SHARE),
+            bytes([agg_id]),
+            self.proofs * self.flp.PROOF_LEN,
+        )
+
+    def _joint_rand_part(self, blind: bytes, agg_id: int, nonce: bytes,
+                         meas_share: list[int]) -> bytes:
+        binder = bytes([agg_id]) + nonce + self.field.encode_vec(meas_share)
+        return self.xof.derive_seed(blind, self.dst(USAGE_JOINT_RAND_PART), binder)
+
+    def _joint_rand_seed(self, parts: list[bytes]) -> bytes:
+        return self.xof.derive_seed(
+            bytes(self.SEED_SIZE), self.dst(USAGE_JOINT_RAND_SEED), b"".join(parts)
+        )
+
+    def _joint_rands(self, seed: bytes) -> list[int]:
+        return self.xof.expand_into_vec(
+            self.field, seed, self.dst(USAGE_JOINT_RANDOMNESS), b"",
+            self.proofs * self.flp.JOINT_RAND_LEN,
+        )
+
+    # -- client ----------------------------------------------------------
+
+    def shard(self, measurement, nonce: bytes, rand: bytes):
+        """-> (public_share: list[bytes] | None, input_shares: list)
+
+        input_shares[0] (leader) = (meas_share, proofs_share, blind|None);
+        input_shares[j>0] (helpers) = (seed, blind|None).
+        """
+        assert len(nonce) == NONCE_SIZE
+        assert len(rand) == self.RAND_SIZE
+        f = self.field
+        seeds = [rand[i * self.SEED_SIZE : (i + 1) * self.SEED_SIZE]
+                 for i in range(len(rand) // self.SEED_SIZE)]
+        helper_seeds = seeds[: self.shares - 1]
+        idx = self.shares - 1
+        if self.has_joint_rand:
+            blinds = seeds[idx : idx + self.shares]
+            idx += self.shares
+        else:
+            blinds = [None] * self.shares
+        prove_seed = seeds[idx]
+
+        meas = self.flp.valid.encode(measurement)
+        leader_meas = list(meas)
+        helper_meas = []
+        for j in range(1, self.shares):
+            hm = self._helper_meas_share(helper_seeds[j - 1], j)
+            helper_meas.append(hm)
+            leader_meas = f.vec_sub(leader_meas, hm)
+
+        public_share = None
+        joint_rands = [0] * (self.proofs * self.flp.JOINT_RAND_LEN)
+        if self.has_joint_rand:
+            parts = [self._joint_rand_part(blinds[0], 0, nonce, leader_meas)]
+            for j in range(1, self.shares):
+                parts.append(self._joint_rand_part(blinds[j], j, nonce, helper_meas[j - 1]))
+            public_share = parts
+            joint_rands = self._joint_rands(self._joint_rand_seed(parts))
+
+        prove_rands = self.xof.expand_into_vec(
+            f, prove_seed, self.dst(USAGE_PROVE_RANDOMNESS), b"",
+            self.proofs * self.flp.PROVE_RAND_LEN,
+        )
+        proofs = []
+        for p in range(self.proofs):
+            pr = prove_rands[p * self.flp.PROVE_RAND_LEN : (p + 1) * self.flp.PROVE_RAND_LEN]
+            jr = joint_rands[p * self.flp.JOINT_RAND_LEN : (p + 1) * self.flp.JOINT_RAND_LEN]
+            proofs.extend(self.flp.prove(meas, pr, jr))
+
+        leader_proofs = list(proofs)
+        for j in range(1, self.shares):
+            leader_proofs = f.vec_sub(leader_proofs, self._helper_proofs_share(helper_seeds[j - 1], j))
+
+        input_shares = [(leader_meas, leader_proofs, blinds[0])]
+        for j in range(1, self.shares):
+            input_shares.append((helper_seeds[j - 1], blinds[j]))
+        return public_share, input_shares
+
+    # -- preparation -----------------------------------------------------
+
+    def prep_init(self, verify_key: bytes, agg_id: int, nonce: bytes,
+                  public_share, input_share):
+        """-> (PrepState, PrepShare)"""
+        assert len(verify_key) == self.VERIFY_KEY_SIZE
+        f = self.field
+        if agg_id == 0:
+            meas_share, proofs_share, blind = input_share
+        else:
+            seed, blind = input_share
+            meas_share = self._helper_meas_share(seed, agg_id)
+            proofs_share = self._helper_proofs_share(seed, agg_id)
+
+        joint_rand_part = None
+        joint_rand_seed = None
+        joint_rands = [0] * (self.proofs * self.flp.JOINT_RAND_LEN)
+        if self.has_joint_rand:
+            joint_rand_part = self._joint_rand_part(blind, agg_id, nonce, meas_share)
+            parts = list(public_share)
+            if len(parts) != self.shares:
+                raise VdafError("public share has wrong number of joint rand parts")
+            parts[agg_id] = joint_rand_part
+            joint_rand_seed = self._joint_rand_seed(parts)
+            joint_rands = self._joint_rands(joint_rand_seed)
+
+        query_rands = self.xof.expand_into_vec(
+            f, verify_key, self.dst(USAGE_QUERY_RANDOMNESS), nonce,
+            self.proofs * self.flp.QUERY_RAND_LEN,
+        )
+        verifiers = []
+        for p in range(self.proofs):
+            ps = proofs_share[p * self.flp.PROOF_LEN : (p + 1) * self.flp.PROOF_LEN]
+            qr = query_rands[p * self.flp.QUERY_RAND_LEN : (p + 1) * self.flp.QUERY_RAND_LEN]
+            jr = joint_rands[p * self.flp.JOINT_RAND_LEN : (p + 1) * self.flp.JOINT_RAND_LEN]
+            verifiers.extend(self.flp.query(meas_share, ps, qr, jr, self.shares))
+
+        state = PrepState(self.flp.valid.truncate(meas_share), joint_rand_seed)
+        return state, PrepShare(joint_rand_part, verifiers)
+
+    def prep_shares_to_prep(self, prep_shares: list[PrepShare]) -> PrepMessage:
+        """Combine prep shares; raises VdafError if the proof is invalid."""
+        if len(prep_shares) != self.shares:
+            raise VdafError("wrong number of prep shares")
+        f = self.field
+        vlen = self.proofs * self.flp.VERIFIER_LEN
+        verifier = [0] * vlen
+        for ps in prep_shares:
+            if len(ps.verifiers) != vlen:
+                raise VdafError("verifier share has wrong length")
+            verifier = f.vec_add(verifier, ps.verifiers)
+        for p in range(self.proofs):
+            v = verifier[p * self.flp.VERIFIER_LEN : (p + 1) * self.flp.VERIFIER_LEN]
+            if not self.flp.decide(v):
+                raise VdafError("proof verification failed")
+        joint_rand_seed = None
+        if self.has_joint_rand:
+            parts = [ps.joint_rand_part for ps in prep_shares]
+            if any(p is None for p in parts):
+                raise VdafError("missing joint rand part")
+            joint_rand_seed = self._joint_rand_seed(parts)
+        return PrepMessage(joint_rand_seed)
+
+    def prep_next(self, state: PrepState, msg: PrepMessage) -> list[int]:
+        """-> out_share; raises VdafError on joint rand mismatch."""
+        if self.has_joint_rand:
+            if msg.joint_rand_seed is None or state.joint_rand_seed is None:
+                raise VdafError("missing joint rand seed")
+            if msg.joint_rand_seed != state.joint_rand_seed:
+                raise VdafError("joint randomness check failed")
+        return state.out_share
+
+    # -- aggregation -----------------------------------------------------
+
+    def aggregate_init(self) -> list[int]:
+        return [0] * self.flp.OUTPUT_LEN
+
+    def aggregate_update(self, agg_share: list[int], out_share: list[int]) -> list[int]:
+        return self.field.vec_add(agg_share, out_share)
+
+    def unshard(self, agg_shares: list[list[int]], num_measurements: int):
+        f = self.field
+        total = [0] * self.flp.OUTPUT_LEN
+        for s in agg_shares:
+            total = f.vec_add(total, s)
+        return self.flp.valid.decode(total, num_measurements)
+
+    # -- codecs (DAP carries all of these as opaque bytes) ---------------
+
+    def encode_public_share(self, public_share) -> bytes:
+        if not self.has_joint_rand:
+            return b""
+        return b"".join(public_share)
+
+    def decode_public_share(self, data: bytes):
+        if not self.has_joint_rand:
+            if data:
+                raise VdafError("unexpected public share bytes")
+            return None
+        if len(data) != self.shares * self.SEED_SIZE:
+            raise VdafError("bad public share length")
+        return [data[i * self.SEED_SIZE : (i + 1) * self.SEED_SIZE] for i in range(self.shares)]
+
+    def encode_input_share(self, agg_id: int, input_share) -> bytes:
+        f = self.field
+        if agg_id == 0:
+            meas_share, proofs_share, blind = input_share
+            out = f.encode_vec(meas_share) + f.encode_vec(proofs_share)
+            if self.has_joint_rand:
+                out += blind
+            return out
+        seed, blind = input_share
+        out = seed
+        if self.has_joint_rand:
+            out += blind
+        return out
+
+    def decode_input_share(self, agg_id: int, data: bytes):
+        f = self.field
+        blind = None
+        if agg_id == 0:
+            n_meas = self.flp.MEAS_LEN * f.ENCODED_SIZE
+            n_proof = self.proofs * self.flp.PROOF_LEN * f.ENCODED_SIZE
+            want = n_meas + n_proof + (self.SEED_SIZE if self.has_joint_rand else 0)
+            if len(data) != want:
+                raise VdafError("bad leader input share length")
+            meas_share = f.decode_vec(data[:n_meas])
+            proofs_share = f.decode_vec(data[n_meas : n_meas + n_proof])
+            if self.has_joint_rand:
+                blind = data[n_meas + n_proof :]
+            return (meas_share, proofs_share, blind)
+        want = self.SEED_SIZE + (self.SEED_SIZE if self.has_joint_rand else 0)
+        if len(data) != want:
+            raise VdafError("bad helper input share length")
+        seed = data[: self.SEED_SIZE]
+        if self.has_joint_rand:
+            blind = data[self.SEED_SIZE :]
+        return (seed, blind)
+
+    def encode_prep_share(self, ps: PrepShare) -> bytes:
+        out = b""
+        if self.has_joint_rand:
+            out += ps.joint_rand_part
+        return out + self.field.encode_vec(ps.verifiers)
+
+    def decode_prep_share(self, data: bytes) -> PrepShare:
+        part = None
+        if self.has_joint_rand:
+            if len(data) < self.SEED_SIZE:
+                raise VdafError("bad prep share length")
+            part = data[: self.SEED_SIZE]
+            data = data[self.SEED_SIZE :]
+        want = self.proofs * self.flp.VERIFIER_LEN * self.field.ENCODED_SIZE
+        if len(data) != want:
+            raise VdafError("bad prep share length")
+        return PrepShare(part, self.field.decode_vec(data))
+
+    def encode_prep_message(self, msg: PrepMessage) -> bytes:
+        return msg.joint_rand_seed if self.has_joint_rand else b""
+
+    def decode_prep_message(self, data: bytes) -> PrepMessage:
+        if not self.has_joint_rand:
+            if data:
+                raise VdafError("unexpected prep message bytes")
+            return PrepMessage(None)
+        if len(data) != self.SEED_SIZE:
+            raise VdafError("bad prep message length")
+        return PrepMessage(data)
+
+    def encode_out_share(self, out_share: list[int]) -> bytes:
+        return self.field.encode_vec(out_share)
+
+    def decode_out_share(self, data: bytes) -> list[int]:
+        out = self.field.decode_vec(data)
+        if len(out) != self.flp.OUTPUT_LEN:
+            raise VdafError("bad out share length")
+        return out
+
+    def encode_agg_share(self, agg_share: list[int]) -> bytes:
+        return self.field.encode_vec(agg_share)
+
+    def decode_agg_share(self, data: bytes) -> list[int]:
+        out = self.field.decode_vec(data)
+        if len(out) != self.flp.OUTPUT_LEN:
+            raise VdafError("bad aggregate share length")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# constructors mirroring core/src/vdaf.rs:178-195
+# ---------------------------------------------------------------------------
+
+
+def new_count() -> Prio3:
+    from janus_tpu.vdaf.flp import Count
+
+    return Prio3(Flp(Count()), ALGO_PRIO3_COUNT)
+
+
+def new_sum(bits: int) -> Prio3:
+    from janus_tpu.vdaf.flp import Sum
+
+    return Prio3(Flp(Sum(bits)), ALGO_PRIO3_SUM)
+
+
+def new_sum_vec(length: int, bits: int, chunk_length: int) -> Prio3:
+    from janus_tpu.vdaf.flp import SumVec
+
+    return Prio3(Flp(SumVec(length, bits, chunk_length)), ALGO_PRIO3_SUM_VEC)
+
+
+def new_histogram(length: int, chunk_length: int) -> Prio3:
+    from janus_tpu.vdaf.flp import Histogram
+
+    return Prio3(Flp(Histogram(length, chunk_length)), ALGO_PRIO3_HISTOGRAM)
+
+
+def new_sum_vec_field64_multiproof_hmac(
+    length: int, bits: int, chunk_length: int, proofs: int
+) -> Prio3:
+    from janus_tpu.vdaf.field_ref import Field64
+    from janus_tpu.vdaf.flp import SumVec
+    from janus_tpu.vdaf.xof import XofHmacSha256Aes128
+
+    assert proofs >= 2
+    return Prio3(
+        Flp(SumVec(length, bits, chunk_length, field=Field64)),
+        ALGO_PRIO3_SUM_VEC_FIELD64_MULTIPROOF_HMAC,
+        proofs=proofs,
+        xof=XofHmacSha256Aes128,
+    )

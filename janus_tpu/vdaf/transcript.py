@@ -1,0 +1,65 @@
+"""VdafTranscript fixture: run a full VDAF exchange in memory, recording
+every intermediate state and message.
+
+Mirrors the reference's `run_vdaf` test oracle (core/src/test_util/mod.rs:49,86
+— SURVEY.md §4 tier 3): the recorded prepare shares/messages are the expected
+values that handler/driver tests — and the TPU batch engine — must reproduce
+bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from janus_tpu.vdaf.prio3 import PrepMessage, PrepShare, Prio3
+
+
+@dataclass
+class VdafTranscript:
+    nonce: bytes
+    rand: bytes
+    public_share: object
+    input_shares: list
+    prep_states: list  # per aggregator
+    prep_shares: list[PrepShare]
+    prep_message: PrepMessage
+    out_shares: list  # per aggregator
+    # encoded forms (what travels on the DAP wire)
+    encoded_public_share: bytes = b""
+    encoded_input_shares: list = field(default_factory=list)
+    encoded_prep_shares: list = field(default_factory=list)
+    encoded_prep_message: bytes = b""
+
+
+def run_vdaf(vdaf: Prio3, verify_key: bytes, measurement, nonce: bytes | None = None,
+             rand: bytes | None = None) -> VdafTranscript:
+    """Execute shard -> prep (all aggregators) -> out shares, recording all."""
+    nonce = os.urandom(16) if nonce is None else nonce
+    rand = os.urandom(vdaf.RAND_SIZE) if rand is None else rand
+    public_share, input_shares = vdaf.shard(measurement, nonce, rand)
+
+    prep_states, prep_shares = [], []
+    for agg_id in range(vdaf.shares):
+        st, ps = vdaf.prep_init(verify_key, agg_id, nonce, public_share, input_shares[agg_id])
+        prep_states.append(st)
+        prep_shares.append(ps)
+    prep_message = vdaf.prep_shares_to_prep(prep_shares)
+    out_shares = [vdaf.prep_next(st, prep_message) for st in prep_states]
+
+    return VdafTranscript(
+        nonce=nonce,
+        rand=rand,
+        public_share=public_share,
+        input_shares=input_shares,
+        prep_states=prep_states,
+        prep_shares=prep_shares,
+        prep_message=prep_message,
+        out_shares=out_shares,
+        encoded_public_share=vdaf.encode_public_share(public_share),
+        encoded_input_shares=[
+            vdaf.encode_input_share(i, s) for i, s in enumerate(input_shares)
+        ],
+        encoded_prep_shares=[vdaf.encode_prep_share(p) for p in prep_shares],
+        encoded_prep_message=vdaf.encode_prep_message(prep_message),
+    )

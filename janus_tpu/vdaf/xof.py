@@ -1,0 +1,157 @@
+"""XOFs (extendable output functions) for the VDAF layer — Python oracle.
+
+Mirrors the XOF surface the reference consumes from prio 0.16
+(core/src/vdaf.rs:16-24: 16-byte verify keys for TurboShake128, 32-byte for
+HmacSha256Aes128; SURVEY.md §2.8).  Conventions follow the VDAF-08 spec
+semantics: an XOF is initialized with (seed, dst), fed a binder string, and
+squeezed into bytes or rejection-sampled field elements.
+
+The TPU engine reimplements these streams as batched Keccak kernels; this
+module is the bit-exactness oracle for them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+
+from janus_tpu.vdaf import keccak_ref
+from janus_tpu.vdaf.field_ref import Field
+
+# TurboSHAKE128 domain-separation byte used by XofTurboShake128.
+TURBOSHAKE_DOMAIN = 0x01
+
+
+class XofTurboShake128:
+    """XofTurboShake128: TurboSHAKE128 over (len(dst) || dst || seed || binder)."""
+
+    SEED_SIZE = 16
+
+    def __init__(self, seed: bytes, dst: bytes):
+        assert len(seed) == self.SEED_SIZE
+        assert len(dst) < 256
+        self._message = bytearray()
+        self._message.append(len(dst))
+        self._message.extend(dst)
+        self._message.extend(seed)
+        self._squeezed = 0
+        self._lanes = None
+
+    def update(self, binder: bytes) -> None:
+        assert self._lanes is None, "cannot absorb after squeezing"
+        self._message.extend(binder)
+
+    def _squeeze(self, length: int) -> bytes:
+        # Oracle-grade incremental squeeze: recompute the sponge absorb once,
+        # then stream blocks.
+        if self._lanes is None:
+            p = bytearray(self._message)
+            p.append(TURBOSHAKE_DOMAIN)
+            if len(p) % 168:
+                p.extend(b"\x00" * (168 - len(p) % 168))
+            p[-1] ^= 0x80
+            lanes = [0] * 25
+            for off in range(0, len(p), 168):
+                for i in range(21):
+                    lanes[i] ^= int.from_bytes(p[off + 8 * i : off + 8 * i + 8], "little")
+                lanes = keccak_ref.permute(lanes, 12)
+            self._lanes = lanes
+            self._buffer = bytearray()
+        while len(self._buffer) < length:
+            for i in range(21):
+                self._buffer.extend(self._lanes[i].to_bytes(8, "little"))
+            self._lanes = keccak_ref.permute(self._lanes, 12)
+        out = bytes(self._buffer[:length])
+        del self._buffer[:length]
+        return out
+
+    def next(self, length: int) -> bytes:
+        return self._squeeze(length)
+
+    def next_vec(self, field: type[Field], length: int) -> list[int]:
+        """Rejection-sample `length` field elements from the stream."""
+        out = []
+        n = field.ENCODED_SIZE
+        while len(out) < length:
+            x = int.from_bytes(self.next(n), "little")
+            if x < field.MODULUS:
+                out.append(x)
+        return out
+
+    # -- conveniences mirroring the spec helpers -------------------------
+
+    @classmethod
+    def seed_stream(cls, seed: bytes, dst: bytes, binder: bytes) -> "XofTurboShake128":
+        xof = cls(seed, dst)
+        xof.update(binder)
+        return xof
+
+    @classmethod
+    def expand_into_vec(
+        cls, field: type[Field], seed: bytes, dst: bytes, binder: bytes, length: int
+    ) -> list[int]:
+        return cls.seed_stream(seed, dst, binder).next_vec(field, length)
+
+    @classmethod
+    def derive_seed(cls, seed: bytes, dst: bytes, binder: bytes) -> bytes:
+        return cls.seed_stream(seed, dst, binder).next(cls.SEED_SIZE)
+
+
+class XofHmacSha256Aes128:
+    """XofHmacSha256Aes128: HMAC-SHA256 key derivation + AES128-CTR keystream.
+
+    Reconstruction of prio's multiproof XOF (32-byte seeds, core/src/vdaf.rs:24):
+    mac = HMAC-SHA256(key=seed, msg=len(dst) || dst || binder); the stream is
+    AES-128-CTR with key mac[0:16] and IV mac[16:32].
+    """
+
+    SEED_SIZE = 32
+
+    def __init__(self, seed: bytes, dst: bytes):
+        assert len(seed) == self.SEED_SIZE
+        assert len(dst) < 256
+        self._seed = seed
+        self._message = bytearray()
+        self._message.append(len(dst))
+        self._message.extend(dst)
+        self._stream_pos = 0
+        self._cipher = None
+
+    def update(self, binder: bytes) -> None:
+        assert self._cipher is None, "cannot absorb after squeezing"
+        self._message.extend(binder)
+
+    def next(self, length: int) -> bytes:
+        if self._cipher is None:
+            mac = hmac_mod.new(self._seed, bytes(self._message), hashlib.sha256).digest()
+            from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+            self._cipher = Cipher(
+                algorithms.AES(mac[:16]), modes.CTR(mac[16:32])
+            ).encryptor()
+        return self._cipher.update(b"\x00" * length)
+
+    def next_vec(self, field: type[Field], length: int) -> list[int]:
+        out = []
+        n = field.ENCODED_SIZE
+        while len(out) < length:
+            x = int.from_bytes(self.next(n), "little")
+            if x < field.MODULUS:
+                out.append(x)
+        return out
+
+    @classmethod
+    def seed_stream(cls, seed: bytes, dst: bytes, binder: bytes) -> "XofHmacSha256Aes128":
+        xof = cls(seed, dst)
+        xof.update(binder)
+        return xof
+
+    @classmethod
+    def expand_into_vec(
+        cls, field: type[Field], seed: bytes, dst: bytes, binder: bytes, length: int
+    ) -> list[int]:
+        return cls.seed_stream(seed, dst, binder).next_vec(field, length)
+
+    @classmethod
+    def derive_seed(cls, seed: bytes, dst: bytes, binder: bytes) -> bytes:
+        return cls.seed_stream(seed, dst, binder).next(cls.SEED_SIZE)
