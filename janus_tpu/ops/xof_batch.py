@@ -1,0 +1,162 @@
+"""Batched XofTurboShake128 streams: message assembly + field-element sampling.
+
+This is the device-side form of janus_tpu.vdaf.xof.XofTurboShake128 (itself
+mirroring the XOF the reference consumes from prio 0.16 — core/src/vdaf.rs:16;
+SURVEY.md §2.8, §3.2).  Where the oracle runs one sponge per report, these
+functions run the sponge across a whole report batch at once:
+
+- Messages are assembled as uint8 arrays (static prefix bytes broadcast over
+  the batch, dynamic per-report parts concatenated), padded with the
+  TurboSHAKE domain byte, and bitcast to the 64-bit lane-pair layout of
+  janus_tpu.ops.keccak (bitcast is little-endian on every XLA backend, which
+  is exactly Keccak's byte order).
+- Field-element sampling is *speculative* rejection sampling: we squeeze
+  exactly `n` candidates and return a per-report `reject` flag that is set iff
+  any candidate fell outside the field (probability ≈ 2^-32 per Field64
+  element, ≈ 2^-61 per Field128 element).  Flagged reports are recomputed on
+  the host oracle; unflagged outputs are bit-identical to the oracle, since a
+  rejection-free stream reads candidate i at offset i.
+
+All shapes are static; everything is jit/vmap/shard-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.ops import keccak
+from janus_tpu.ops.field64 import MODULUS as P64
+from janus_tpu.vdaf.xof import TURBOSHAKE_DOMAIN
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+
+RATE_BYTES = keccak.RATE_BYTES
+RATE_LANES = keccak.RATE_LANES
+
+
+# ---------------------------------------------------------------------------
+# message assembly
+# ---------------------------------------------------------------------------
+
+
+def xof_prefix(dst: bytes, seed: bytes | None = None) -> bytes:
+    """The static message prefix len(dst) || dst [|| seed]."""
+    assert len(dst) < 256
+    out = bytes([len(dst)]) + dst
+    if seed is not None:
+        out += seed
+    return out
+
+
+def build_blocks(batch_shape: tuple, parts, domain: int = TURBOSHAKE_DOMAIN):
+    """Assemble padded sponge blocks for a batch of same-length messages.
+
+    `parts` is a list of message segments in order; each is either static
+    `bytes` (identical for every report, broadcast) or a uint8 array of shape
+    batch_shape + (k,).  Returns uint32 blocks [*batch_shape, nblocks, 21, 2].
+    """
+    segs = []
+    total = 0
+    for p in parts:
+        if isinstance(p, (bytes, bytearray)):
+            if len(p) == 0:
+                continue
+            arr = jnp.asarray(np.frombuffer(bytes(p), dtype=np.uint8))
+            segs.append(jnp.broadcast_to(arr, batch_shape + (len(p),)))
+            total += len(p)
+        else:
+            p = jnp.asarray(p, dtype=_U8)
+            assert p.shape[: len(batch_shape)] == batch_shape, (p.shape, batch_shape)
+            segs.append(p.reshape(batch_shape + (-1,)))
+            total += segs[-1].shape[-1]
+    # pad10*1: append domain byte, zero-fill to the rate, flip the top bit of
+    # the last byte.  All lengths are static, so the pad is static too.
+    padded = total + 1
+    npad = (-padded) % RATE_BYTES
+    tail = bytearray([domain]) + bytes(npad)
+    tail[-1] ^= 0x80
+    segs.append(jnp.broadcast_to(jnp.asarray(np.frombuffer(bytes(tail), dtype=np.uint8)),
+                                 batch_shape + (len(tail),)))
+    msg = jnp.concatenate(segs, axis=-1)
+    nblocks = msg.shape[-1] // RATE_BYTES
+    lanes = jax.lax.bitcast_convert_type(
+        msg.reshape(batch_shape + (nblocks, RATE_LANES, 2, 4)), _U32
+    )
+    return lanes
+
+
+def limbs_to_bytes(x):
+    """Field limb array [..., L] uint32 -> little-endian uint8 [..., 4L]."""
+    b = jax.lax.bitcast_convert_type(x, _U8)  # [..., L, 4]
+    return b.reshape(x.shape[:-1] + (4 * x.shape[-1],))
+
+
+def vec_limbs_to_bytes(x):
+    """Field vector [..., n, L] uint32 -> encoded bytes [..., n*4L] uint8."""
+    b = jax.lax.bitcast_convert_type(x, _U8)  # [..., n, L, 4]
+    return b.reshape(x.shape[:-2] + (x.shape[-2] * 4 * x.shape[-1],))
+
+
+# ---------------------------------------------------------------------------
+# squeezing
+# ---------------------------------------------------------------------------
+
+
+def _squeeze_lanes(blocks, n_lanes: int):
+    """Absorb blocks and squeeze n_lanes: -> [..., n_lanes, 2] uint32."""
+    state = keccak.absorb(blocks)
+    lanes, _ = keccak.squeeze(state, n_lanes)
+    return lanes
+
+
+def derive_seed(batch_shape: tuple, parts, seed_size: int = 16):
+    """Batched XofTurboShake128 derive_seed: -> uint8 [*batch_shape, seed_size]."""
+    assert seed_size % 8 == 0
+    lanes = _squeeze_lanes(build_blocks(batch_shape, parts), seed_size // 8)
+    return jax.lax.bitcast_convert_type(lanes, _U8).reshape(batch_shape + (seed_size,))
+
+
+def expand_field64(batch_shape: tuple, parts, n: int):
+    """Sample n Field64 elements per report.
+
+    Returns (elems [*batch_shape, n, 2] uint32, reject [*batch_shape] bool).
+    Where reject is False the elements equal the oracle's rejection-sampled
+    stream exactly; where True the values are unusable (host fallback).
+    """
+    lanes = _squeeze_lanes(build_blocks(batch_shape, parts), n)
+    lo, hi = lanes[..., 0], lanes[..., 1]
+    # candidate >= p  <=>  hi == 2^32 - 1 and lo >= 1 (p = 2^64 - 2^32 + 1)
+    bad = (hi == _U32(0xFFFFFFFF)) & (lo >= _U32(1))
+    return lanes, jnp.any(bad, axis=-1)
+
+
+_P128 = (1 << 128) - (7 << 66) + 1
+_P128_LIMBS = tuple((_P128 >> (32 * i)) & 0xFFFFFFFF for i in range(4))
+
+
+def expand_field128(batch_shape: tuple, parts, n: int):
+    """Sample n Field128 elements per report: each is two consecutive lanes.
+
+    Returns (elems [*batch_shape, n, 4] uint32, reject [*batch_shape] bool).
+    """
+    lanes = _squeeze_lanes(build_blocks(batch_shape, parts), 2 * n)
+    limbs = lanes.reshape(batch_shape + (n, 4))
+    # candidate >= p: lexicographic compare from the top limb down.
+    eq = jnp.ones(batch_shape + (n,), dtype=bool)
+    gt = jnp.zeros(batch_shape + (n,), dtype=bool)
+    for i in range(3, -1, -1):
+        c = jnp.asarray(np.uint32(_P128_LIMBS[i]))
+        gt = gt | (eq & (limbs[..., i] > c))
+        eq = eq & (limbs[..., i] == c)
+    bad = gt | eq
+    return limbs, jnp.any(bad, axis=-1)
+
+
+def seed_bytes_to_u8(seeds) -> jnp.ndarray:
+    """Host helper: list/array of seed byte strings -> uint8 [N, seed_len]."""
+    if isinstance(seeds, (list, tuple)):
+        return jnp.asarray(np.frombuffer(b"".join(seeds), dtype=np.uint8).reshape(len(seeds), -1))
+    return jnp.asarray(seeds, dtype=_U8)
