@@ -1,0 +1,389 @@
+"""Field128 (p = 2^128 - 7*2^66 + 1) as vectorized uint32-limb JAX ops.
+
+The 128-bit VDAF field under Prio3Sum / Prio3SumVec / Prio3Histogram
+(reference: the `prio` crate's Field128, consumed via core/src/vdaf.rs:67-87;
+SURVEY.md §2.8).  Like janus_tpu.ops.field64 this is re-designed for the TPU
+VPU — no 64-bit integers, no data-dependent branches — but unlike the
+Goldilocks field, p has no cheap raw reduction, so elements live in
+**Montgomery form** (x·R mod p, R = 2^128) on device:
+
+- A Field128 array of logical shape S is a uint32 array of shape S + (4,)
+  (limb 0 = least significant 32 bits), in Montgomery form, canonical (< p).
+- `mul` is CIOS Montgomery multiplication.  Because p ≡ 1 (mod 2^32), the
+  per-limb Montgomery factor is m = -t0 mod 2^32: no extra multiply.
+- Raw (standard-form) limb data — e.g. XOF output lanes from
+  janus_tpu.ops.xof_batch — enters via `from_raw` and leaves via `to_raw`.
+  For Field64 the equivalent hooks are the identity.
+
+Tested bit-for-bit against janus_tpu.vdaf.field_ref.Field128.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+MODULUS = (1 << 128) - (7 << 66) + 1
+GEN_ORDER = 1 << 66
+GENERATOR = pow(7, (MODULUS - 1) >> 66, MODULUS)
+LIMBS = 4
+
+R = (1 << 128) % MODULUS
+R2 = R * R % MODULUS
+
+_U32 = jnp.uint32
+_MASK16 = jnp.uint32(0xFFFF)
+
+_P_LIMBS_INT = tuple((MODULUS >> (32 * i)) & 0xFFFFFFFF for i in range(4))
+assert _P_LIMBS_INT == (1, 0, 0xFFFFFFE4, 0xFFFFFFFF)
+
+
+def _limbs(value: int) -> np.ndarray:
+    return np.array([(value >> (32 * i)) & 0xFFFFFFFF for i in range(4)], dtype=np.uint32)
+
+
+_P = _limbs(MODULUS)
+
+
+# ---------------------------------------------------------------------------
+# packing helpers (host side; mont conversion done in Python ints)
+# ---------------------------------------------------------------------------
+
+
+def pack(values) -> np.ndarray:
+    """Python ints -> Montgomery-form uint32 limb array (shape + (4,))."""
+    flat = np.ravel(np.array(values, dtype=object))
+    arr = np.asarray(
+        [_limbs((int(v) % MODULUS) * R % MODULUS) for v in flat], dtype=np.uint32
+    )
+    shape = np.shape(np.array(values, dtype=object))
+    return arr.reshape(shape + (4,))
+
+
+def unpack(x) -> np.ndarray:
+    """Montgomery uint32 limb array -> numpy object array of Python ints."""
+    x = np.asarray(x)
+    rinv = pow(R, MODULUS - 2, MODULUS)
+    acc = np.zeros(x.shape[:-1], dtype=object)
+    for i in range(4):
+        acc = acc + (x[..., i].astype(object) << (32 * i))
+    acc = np.asarray(acc, dtype=object)
+    flat = np.ravel(acc)
+    out = np.array([int(v) * rinv % MODULUS for v in flat], dtype=object)
+    return out.reshape(acc.shape)
+
+
+def zeros(shape) -> jnp.ndarray:
+    return jnp.zeros(tuple(shape) + (4,), dtype=_U32)
+
+
+def ones(shape) -> jnp.ndarray:
+    return jnp.broadcast_to(jnp.asarray(_limbs(R)), tuple(shape) + (4,))
+
+
+def const(value: int):
+    """A scalar field constant (Montgomery form) as a (4,) uint32 array."""
+    return jnp.asarray(_limbs((value % MODULUS) * R % MODULUS))
+
+
+# ---------------------------------------------------------------------------
+# primitive limb ops
+# ---------------------------------------------------------------------------
+
+
+def _mul32(a, b):
+    """Full 32x32 -> 64-bit product as (lo, hi) uint32, via 16-bit partials."""
+    a0 = a & _MASK16
+    a1 = a >> 16
+    b0 = b & _MASK16
+    b1 = b >> 16
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    mid = lh + hl
+    mid_carry = (mid < lh).astype(_U32)
+    lo = ll + ((mid & _MASK16) << 16)
+    lo_carry = (lo < ll).astype(_U32)
+    hi = hh + (mid >> 16) + (mid_carry << 16) + lo_carry
+    return lo, hi
+
+
+def _addv(x, y):
+    """4-limb add: ([..., 4], [..., 4]) -> (limbs, carry_out)."""
+    out = []
+    carry = jnp.zeros(x.shape[:-1], dtype=_U32)
+    for i in range(4):
+        s = x[..., i] + y[..., i]
+        c1 = (s < x[..., i]).astype(_U32)
+        s2 = s + carry
+        c2 = (s2 < carry).astype(_U32)
+        out.append(s2)
+        carry = c1 | c2  # at most one of the two adds can carry
+    return jnp.stack(out, axis=-1), carry
+
+
+def _subv(x, y):
+    """4-limb subtract: -> (limbs, borrow_out)."""
+    out = []
+    borrow = jnp.zeros(x.shape[:-1], dtype=_U32)
+    for i in range(4):
+        d = x[..., i] - y[..., i]
+        b1 = (x[..., i] < y[..., i]).astype(_U32)
+        d2 = d - borrow
+        b2 = (d < borrow).astype(_U32)
+        out.append(d2)
+        borrow = b1 | b2
+    return jnp.stack(out, axis=-1), borrow
+
+
+def _geq_p(x):
+    """x >= p elementwise over 4-limb values: lexicographic from the top."""
+    gt = jnp.zeros(x.shape[:-1], dtype=bool)
+    eq = jnp.ones(x.shape[:-1], dtype=bool)
+    for i in range(3, -1, -1):
+        c = jnp.asarray(np.uint32(_P_LIMBS_INT[i]))
+        gt = gt | (eq & (x[..., i] > c))
+        eq = eq & (x[..., i] == c)
+    return gt | eq
+
+
+def _cond_sub_p(x, force=None):
+    """Subtract p where x >= p (or where `force`); x < 2p assumed."""
+    need = _geq_p(x) if force is None else (force | _geq_p(x))
+    sub, _ = _subv(x, jnp.broadcast_to(jnp.asarray(_P), x.shape))
+    return jnp.where(need[..., None], sub, x)
+
+
+# ---------------------------------------------------------------------------
+# field ops (Montgomery form in, Montgomery form out)
+# ---------------------------------------------------------------------------
+
+
+def add(x, y):
+    s, carry = _addv(x, y)
+    # carry can only be set transiently for x + y >= 2^128 > p; value < 2p
+    # always, so carry implies s (mod 2^128) = x + y - 2^128 < p... but then
+    # we must add back 2^128 - p = c.  Equivalently: subtract p when
+    # carry || s >= p; with wrapping limbs, (s - p) mod 2^128 is correct in
+    # both cases.
+    return _cond_sub_p(s, force=carry.astype(bool))
+
+
+def sub(x, y):
+    d, borrow = _subv(x, y)
+    addp, _ = _addv(d, jnp.broadcast_to(jnp.asarray(_P), d.shape))
+    return jnp.where(borrow.astype(bool)[..., None], addp, d)
+
+
+def neg(x):
+    return sub(zeros(x.shape[:-1]), x)
+
+
+def mul(x, y):
+    """CIOS Montgomery multiply: mont(a), mont(b) -> mont(a*b)."""
+    batch = x.shape[:-1]
+    zero = jnp.zeros(batch, dtype=_U32)
+    t = [zero] * 5
+    t5 = zero
+    for i in range(4):
+        xi = x[..., i]
+        # T += x_i * y
+        carry = zero
+        for j in range(4):
+            lo, hi = _mul32(xi, y[..., j])
+            s = t[j] + lo
+            c1 = (s < lo).astype(_U32)
+            s2 = s + carry
+            c2 = (s2 < carry).astype(_U32)
+            t[j] = s2
+            carry = hi + c1 + c2  # hi <= 2^32 - 2, so no overflow
+        s = t[4] + carry
+        t5 = t5 + (s < carry).astype(_U32)
+        t[4] = s
+        # Montgomery step: m = -t0 mod 2^32 (p ≡ 1 mod 2^32); T = (T + m*p)/2^32
+        m = zero - t[0]
+        # j = 0: t[0] + m*1 == 0 mod 2^32, carry = (t0 != 0)
+        carry = (t[0] != 0).astype(_U32)
+        # j = 1: p_1 = 0
+        s = t[1] + carry
+        t[0] = s
+        carry = (s < carry).astype(_U32)
+        for j in (2, 3):
+            lo, hi = _mul32(m, jnp.asarray(np.uint32(_P_LIMBS_INT[j])))
+            s = t[j] + lo
+            c1 = (s < lo).astype(_U32)
+            s2 = s + carry
+            c2 = (s2 < carry).astype(_U32)
+            t[j - 1] = s2
+            carry = hi + c1 + c2
+        s = t[4] + carry
+        t[3] = s
+        c = (s < carry).astype(_U32)
+        t[4] = t5 + c
+        t5 = zero
+    # value = t4 * 2^128 + t[0..3] < 2p: one wrapping subtract of p suffices
+    # whenever t4 is set or t >= p.
+    res = jnp.stack(t[:4], axis=-1)
+    return _cond_sub_p(res, force=t[4].astype(bool))
+
+
+def square(x):
+    return mul(x, x)
+
+
+def mul_const(x, value: int):
+    c = const(value)
+    return mul(x, jnp.broadcast_to(c, x.shape))
+
+
+def pow_static(x, e: int):
+    assert e >= 0
+    result = ones(x.shape[:-1])
+    base = x
+    while e:
+        if e & 1:
+            result = mul(result, base)
+        base = square(base)
+        e >>= 1
+    return result
+
+
+def inv(x):
+    return pow_static(x, MODULUS - 2)
+
+
+def eq(x, y):
+    out = jnp.ones(x.shape[:-1], dtype=bool)
+    for i in range(4):
+        out = out & (x[..., i] == y[..., i])
+    return out
+
+
+def is_zero(x):
+    out = jnp.ones(x.shape[:-1], dtype=bool)
+    for i in range(4):
+        out = out & (x[..., i] == 0)
+    return out
+
+
+def select(mask, x, y):
+    return jnp.where(mask[..., None], x, y)
+
+
+# ---------------------------------------------------------------------------
+# raw <-> Montgomery (device side)
+# ---------------------------------------------------------------------------
+
+
+def from_raw(x):
+    """Standard-form limbs (e.g. XOF lanes, < p) -> Montgomery form."""
+    return mul(x, jnp.broadcast_to(jnp.asarray(_limbs(R2)), x.shape))
+
+
+def to_raw(x):
+    """Montgomery form -> standard-form limbs (little-endian encoding order)."""
+    one = np.zeros(4, dtype=np.uint32)
+    one[0] = 1
+    return mul(x, jnp.broadcast_to(jnp.asarray(one), x.shape))
+
+
+# ---------------------------------------------------------------------------
+# reductions / polynomials / NTT (same surface as ops.field64)
+# ---------------------------------------------------------------------------
+
+
+def sum_mod(x, axis: int = -1):
+    if axis < 0:
+        axis = x.ndim - 1 + axis
+    assert 0 <= axis < x.ndim - 1
+    x = jnp.moveaxis(x, axis, 0)
+    n = x.shape[0]
+    m = 1
+    while m < n:
+        m *= 2
+    if m != n:
+        pad = jnp.zeros((m - n,) + x.shape[1:], dtype=x.dtype)
+        x = jnp.concatenate([x, pad], axis=0)
+    while x.shape[0] > 1:
+        half = x.shape[0] // 2
+        x = add(x[:half], x[half:])
+    return x[0]
+
+
+def dot(x, y, axis: int = -1):
+    return sum_mod(mul(x, y), axis=axis)
+
+
+def poly_eval(coeffs, x):
+    n = coeffs.shape[0]
+    acc = coeffs[n - 1]
+    for i in range(n - 2, -1, -1):
+        acc = add(mul(acc, x), coeffs[i])
+    return acc
+
+
+def powers(x, n: int):
+    out = [ones(x.shape[:-1])]
+    for _ in range(n - 1):
+        out.append(mul(out[-1], x))
+    return jnp.stack(out, axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def _bitrev(n: int) -> np.ndarray:
+    bits = n.bit_length() - 1
+    idx = np.arange(n)
+    rev = np.zeros(n, dtype=np.int64)
+    for b in range(bits):
+        rev |= ((idx >> b) & 1) << (bits - 1 - b)
+    return rev
+
+
+@functools.lru_cache(maxsize=None)
+def _twiddles(n: int, inverse: bool) -> tuple:
+    w = pow(GENERATOR, GEN_ORDER // n, MODULUS)
+    if inverse:
+        w = pow(w, MODULUS - 2, MODULUS)
+    tables = []
+    m = 2
+    while m <= n:
+        wm = pow(w, n // m, MODULUS)
+        tw = [pow(wm, k, MODULUS) for k in range(m // 2)]
+        tables.append(pack(tw))
+        m *= 2
+    return tuple(tables)
+
+
+def _ntt_core(x, n: int, inverse: bool):
+    batch = x.shape[:-2]
+    x = x[..., _bitrev(n), :]
+    for stage, tw in enumerate(_twiddles(n, inverse)):
+        m = 2 << stage
+        half = m // 2
+        xr = x.reshape(batch + (n // m, 2, half, 4))
+        u = xr[..., 0, :, :]
+        v = mul(xr[..., 1, :, :], jnp.asarray(tw))
+        out = jnp.stack([add(u, v), sub(u, v)], axis=-3)
+        x = out.reshape(batch + (n, 4))
+    return x
+
+
+def ntt(coeffs, n: int | None = None):
+    k = coeffs.shape[-2]
+    if n is None:
+        n = k
+    assert n & (n - 1) == 0 and k <= n
+    if k < n:
+        pad = jnp.zeros(coeffs.shape[:-2] + (n - k, 4), dtype=coeffs.dtype)
+        coeffs = jnp.concatenate([coeffs, pad], axis=-2)
+    return _ntt_core(coeffs, n, inverse=False)
+
+
+def intt(evals):
+    n = evals.shape[-2]
+    assert n & (n - 1) == 0
+    x = _ntt_core(evals, n, inverse=True)
+    return mul_const(x, pow(n, MODULUS - 2, MODULUS))

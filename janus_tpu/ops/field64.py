@@ -25,6 +25,7 @@ import numpy as np
 MODULUS = (1 << 64) - (1 << 32) + 1
 GEN_ORDER = 1 << 32
 GENERATOR = pow(7, (1 << 32) - 1, MODULUS)  # generator of the 2^32 subgroup
+LIMBS = 2
 
 _U32 = jnp.uint32
 _MASK16 = jnp.uint32(0xFFFF)
@@ -242,6 +243,16 @@ def pow_static(x, e: int):
 def inv(x):
     """Multiplicative inverse (x != 0) via Fermat."""
     return pow_static(x, MODULUS - 2)
+
+
+def from_raw(x):
+    """Standard-form limbs -> internal form (identity; parity with field128)."""
+    return x
+
+
+def to_raw(x):
+    """Internal form -> standard-form limbs (identity; parity with field128)."""
+    return x
 
 
 def eq(x, y):

@@ -1,0 +1,98 @@
+"""Field128 Montgomery limb kernels vs the pure-Python oracle."""
+
+import numpy as np
+import pytest
+
+from janus_tpu.ops import field128 as f128
+from janus_tpu.vdaf.field_ref import Field128
+
+
+def test_modulus_matches_oracle():
+    assert f128.MODULUS == Field128.MODULUS
+    assert f128.GENERATOR == Field128.GENERATOR
+    assert f128.GEN_ORDER == Field128.GEN_ORDER
+
+
+def _rand_elems(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [int.from_bytes(rng.bytes(16), "little") % Field128.MODULUS for _ in range(n)]
+
+
+def test_pack_unpack_roundtrip():
+    vals = _rand_elems(10) + [0, 1, Field128.MODULUS - 1]
+    got = f128.unpack(f128.pack(vals))
+    assert list(got) == vals
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("add", Field128.add),
+    ("sub", Field128.sub),
+    ("mul", Field128.mul),
+])
+def test_binary_ops(op, ref):
+    n = 64
+    a = _rand_elems(n, seed=1) + [0, 1, Field128.MODULUS - 1, Field128.MODULUS - 1]
+    b = _rand_elems(n, seed=2) + [0, Field128.MODULUS - 1, 1, Field128.MODULUS - 1]
+    xa, xb = f128.pack(a), f128.pack(b)
+    got = f128.unpack(getattr(f128, op)(xa, xb))
+    want = [ref(x, y) for x, y in zip(a, b)]
+    assert list(got) == want
+
+
+def test_neg_and_inv():
+    vals = _rand_elems(8, seed=3) + [1, Field128.MODULUS - 1]
+    x = f128.pack(vals)
+    assert list(f128.unpack(f128.neg(x))) == [Field128.neg(v) for v in vals]
+    assert list(f128.unpack(f128.inv(x))) == [Field128.inv(v) for v in vals]
+
+
+def test_from_raw_to_raw():
+    vals = _rand_elems(6, seed=4)
+    raw = np.array(
+        [[(v >> (32 * i)) & 0xFFFFFFFF for i in range(4)] for v in vals], dtype=np.uint32
+    )
+    mont = f128.from_raw(raw)
+    assert list(f128.unpack(mont)) == vals
+    back = np.asarray(f128.to_raw(mont))
+    assert back.tolist() == raw.tolist()
+
+
+def test_sum_and_dot():
+    a = _rand_elems(13, seed=5)
+    b = _rand_elems(13, seed=6)
+    xa, xb = f128.pack(a), f128.pack(b)
+    assert int(f128.unpack(f128.sum_mod(xa, axis=0))) == sum(a) % Field128.MODULUS
+    assert int(f128.unpack(f128.dot(xa, xb, axis=0))) == Field128.dot(a, b)
+
+
+def test_poly_eval_and_powers():
+    coeffs = _rand_elems(7, seed=7)
+    x = _rand_elems(3, seed=8)
+    got = f128.unpack(f128.poly_eval(f128.pack(coeffs), f128.pack(x)))
+    assert list(got) == [Field128.poly_eval(coeffs, v) for v in x]
+    pw = f128.unpack(f128.powers(f128.pack(x), 5))
+    for k in range(5):
+        assert list(pw[k]) == [pow(v, k, Field128.MODULUS) for v in x]
+
+
+@pytest.mark.parametrize("n", [2, 8, 64])
+def test_ntt_intt_roundtrip_vs_oracle(n):
+    coeffs = _rand_elems(n, seed=n)
+    evals = f128.unpack(f128.ntt(f128.pack(coeffs)))
+    assert list(evals) == Field128.ntt(coeffs)
+    back = f128.unpack(f128.intt(f128.pack(Field128.ntt(coeffs))))
+    assert list(back) == coeffs
+
+
+def test_batched_shapes():
+    rng = np.random.default_rng(9)
+    vals = np.array(
+        [[int.from_bytes(rng.bytes(16), "little") % Field128.MODULUS for _ in range(4)]
+         for _ in range(3)], dtype=object
+    )
+    x = f128.pack(vals)
+    assert x.shape == (3, 4, 4)
+    out = f128.unpack(f128.mul(x, x))
+    for i in range(3):
+        for j in range(4):
+            assert out[i, j] == int(vals[i, j]) ** 2 % Field128.MODULUS
