@@ -1,0 +1,280 @@
+"""Batched FLP verifier — the device-side form of janus_tpu.vdaf.flp.
+
+This is the FLP `query`/`decide` pipeline (the per-report proof verification
+the reference runs sequentially inside prio — SURVEY.md §0, §2.8) recast as
+static-shape array programs over a report batch:
+
+- Circuit wire values are built by small per-circuit classes (Count, Sum,
+  SumVec, Histogram) as [..., calls, arity, L] limb arrays.
+- Wire polynomials are interpolated with a batched INTT over the p2-subgroup
+  and evaluated at the query point t by Horner (static unroll).
+- The gadget polynomial's values at the call points alpha^(k+1) are obtained
+  by folding its coefficients mod (x^p2 - 1) and running a forward NTT —
+  O(p2 log p2) instead of m Horner evaluations of a degree-2(p2-1) poly.
+- `query` returns a per-report `bad_t` flag where the query randomness lands
+  in the wire-interpolation domain (t^p2 == 1); the oracle raises FlpError
+  there (probability ~p2/p per report) and flagged reports take the host
+  fallback path, preserving bit-exact semantics.
+
+All circuits here have exactly one gadget, matching the oracle
+(janus_tpu/vdaf/flp.py) and the VDAF spec's Prio3 instantiations.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from janus_tpu.ops import field64 as _f64
+from janus_tpu.ops import field128 as _f128
+from janus_tpu.vdaf import flp as _flp
+from janus_tpu.vdaf.field_ref import Field64, Field128
+
+
+def field_ops(field_cls):
+    """Map an oracle field class to its limb-kernel module."""
+    if field_cls is Field64:
+        return _f64
+    if field_cls is Field128:
+        return _f128
+    raise ValueError(f"no limb kernels for {field_cls}")
+
+
+def _horner(f, coeffs, x, axis=-2):
+    """Evaluate polynomials (coefficient axis `axis`, low order first) at x.
+
+    coeffs: [..., n, ..., L]; x broadcastable to the coefficient-slice shape.
+    """
+    c = jnp.moveaxis(coeffs, axis, 0)
+    xb = jnp.broadcast_to(x, c.shape[1:])
+    acc = jnp.broadcast_to(c[-1], xb.shape)
+    for i in range(c.shape[0] - 2, -1, -1):
+        acc = f.add(f.mul(acc, xb), c[i])
+    return acc
+
+
+def _chain_powers(f, r, n: int):
+    """[r^1, ..., r^n] stacked on a new axis before the limb axis."""
+    out = [r]
+    for _ in range(n - 1):
+        out.append(f.mul(out[-1], r))
+    return jnp.stack(out, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# per-circuit batched wire/output/truncate builders
+# ---------------------------------------------------------------------------
+
+
+class _BatchCircuit:
+    """Batched analog of a flp.Valid circuit (wire values + affine output)."""
+
+    def __init__(self, valid, fops):
+        self.valid = valid
+        self.f = fops
+
+    def wires(self, meas, joint_rand, num_shares: int):
+        """-> gadget call inputs [..., calls, arity, L]."""
+        raise NotImplementedError
+
+    def output(self, gadget_outs, meas, joint_rand, num_shares: int):
+        """Affine circuit output share given gadget outputs [..., calls, L]."""
+        raise NotImplementedError
+
+    def truncate(self, meas):
+        """[..., MEAS_LEN, L] -> [..., OUTPUT_LEN, L]."""
+        raise NotImplementedError
+
+
+class _BatchCount(_BatchCircuit):
+    def wires(self, meas, joint_rand, num_shares):
+        x = meas[..., 0:1, :]  # [..., 1, L]
+        return jnp.stack([x, x], axis=-2)  # calls=1, arity=2
+
+    def output(self, gadget_outs, meas, joint_rand, num_shares):
+        return self.f.sub(gadget_outs[..., 0, :], meas[..., 0, :])
+
+    def truncate(self, meas):
+        return meas
+
+
+class _BatchSum(_BatchCircuit):
+    def wires(self, meas, joint_rand, num_shares):
+        return meas[..., :, None, :]  # calls=bits, arity=1
+
+    def output(self, gadget_outs, meas, joint_rand, num_shares):
+        f = self.f
+        r = joint_rand[..., 0, :]
+        w = _chain_powers(f, r, gadget_outs.shape[-2])  # [..., bits, L]
+        return f.sum_mod(f.mul(w, gadget_outs), axis=-1)
+
+    def truncate(self, meas):
+        f = self.f
+        weights = f.pack([1 << i for i in range(self.valid.bits)])
+        return f.sum_mod(f.mul(meas, jnp.asarray(weights)), axis=-1)[..., None, :]
+
+
+class _BatchChunked(_BatchCircuit):
+    """Shared wires for SumVec/Histogram: ParallelSum(Mul, chunk) range check."""
+
+    def _padded_elems(self, meas):
+        v = self.valid
+        calls, chunk = v._calls, v.chunk_length
+        pad = calls * chunk - v.MEAS_LEN
+        if pad:
+            z = jnp.zeros(meas.shape[:-2] + (pad, meas.shape[-1]), dtype=meas.dtype)
+            meas = jnp.concatenate([meas, z], axis=-2)
+        return meas.reshape(meas.shape[:-2] + (calls, chunk, meas.shape[-1]))
+
+    def wires(self, meas, joint_rand, num_shares):
+        f = self.f
+        v = self.valid
+        calls, chunk = v._calls, v.chunk_length
+        elems = self._padded_elems(meas)  # [..., calls, chunk, L]
+        r = joint_rand[..., :calls, :]  # [..., calls, L]
+        rpow = _chain_powers(f, r, chunk)  # [..., calls, chunk, L] (r^1..r^chunk)
+        u = f.mul(rpow, elems)
+        shares_inv = f.const(pow(num_shares, f.MODULUS - 2, f.MODULUS))
+        vwire = f.sub(elems, jnp.broadcast_to(shares_inv, elems.shape))
+        # oracle wire order per call: [u_0, v_0, u_1, v_1, ...]
+        inter = jnp.stack([u, vwire], axis=-2)  # [..., calls, chunk, 2, L]
+        return inter.reshape(inter.shape[:-3] + (2 * chunk, inter.shape[-1]))
+
+
+class _BatchSumVec(_BatchChunked):
+    def output(self, gadget_outs, meas, joint_rand, num_shares):
+        return self.f.sum_mod(gadget_outs, axis=-1)
+
+    def truncate(self, meas):
+        f = self.f
+        v = self.valid
+        m = meas.reshape(meas.shape[:-2] + (v.length, v.bits, meas.shape[-1]))
+        weights = jnp.asarray(f.pack([1 << i for i in range(v.bits)]))
+        return f.sum_mod(f.mul(m, weights), axis=-1)
+
+
+class _BatchHistogram(_BatchChunked):
+    def output(self, gadget_outs, meas, joint_rand, num_shares):
+        f = self.f
+        v = self.valid
+        range_check = f.sum_mod(gadget_outs, axis=-1)
+        shares_inv = f.const(pow(num_shares, f.MODULUS - 2, f.MODULUS))
+        sum_check = f.sub(
+            f.sum_mod(meas, axis=-1), jnp.broadcast_to(shares_inv, range_check.shape)
+        )
+        return f.add(range_check, f.mul(joint_rand[..., v._calls, :], sum_check))
+
+    def truncate(self, meas):
+        return meas
+
+
+_CIRCUITS = {
+    _flp.Count: _BatchCount,
+    _flp.Sum: _BatchSum,
+    _flp.SumVec: _BatchSumVec,
+    _flp.Histogram: _BatchHistogram,
+}
+
+
+# ---------------------------------------------------------------------------
+# the batched FLP
+# ---------------------------------------------------------------------------
+
+
+class BatchFlp:
+    """Batched query/decide for one FLP instance (one gadget, as in Prio3)."""
+
+    def __init__(self, flp: _flp.Flp):
+        assert len(flp.gadgets) == 1, "Prio3 circuits have exactly one gadget"
+        self.flp = flp
+        self.f = field_ops(flp.field)
+        self.gadget = flp.gadgets[0]
+        self.calls = flp.gadget_calls[0]
+        self.p2 = _flp.next_pow2(self.calls + 1)
+        self.arity = self.gadget.ARITY
+        self.ncoeffs = self.gadget.DEGREE * (self.p2 - 1) + 1
+        self.circuit = _CIRCUITS[type(flp.valid)](flp.valid, self.f)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _gadget_outs(self, coeffs):
+        """Gadget poly values at alpha^(k+1), k < calls: fold + forward NTT.
+
+        coeffs: [..., ncoeffs, L] -> [..., calls, L]
+        """
+        f = self.f
+        p2 = self.p2
+        pad = (-self.ncoeffs) % p2
+        if pad:
+            z = jnp.zeros(coeffs.shape[:-2] + (pad, coeffs.shape[-1]), dtype=coeffs.dtype)
+            coeffs = jnp.concatenate([coeffs, z], axis=-2)
+        folded = coeffs.reshape(coeffs.shape[:-2] + (-1, p2, coeffs.shape[-1]))
+        folded = f.sum_mod(folded, axis=-2)  # sum chunks: x^p2 == 1 on the subgroup
+        evals = f.ntt(folded)  # [..., p2, L] at w^j, natural order
+        return evals[..., 1 : self.calls + 1, :]
+
+    def _gadget_eval(self, wires):
+        """Direct gadget evaluation on wire values [..., arity, L] -> [..., L]."""
+        f = self.f
+        g = self.gadget
+        if isinstance(g, _flp.Mul):
+            return f.mul(wires[..., 0, :], wires[..., 1, :])
+        if isinstance(g, _flp.PolyEval):
+            coeffs = jnp.asarray(f.pack(g.coeffs))  # [n, L]
+            x = wires[..., 0, :]
+            acc = jnp.broadcast_to(coeffs[-1], x.shape)
+            for i in range(len(g.coeffs) - 2, -1, -1):
+                acc = f.add(f.mul(acc, x), jnp.broadcast_to(coeffs[i], x.shape))
+            return acc
+        if isinstance(g, _flp.ParallelSum) and isinstance(g.subgadget, _flp.Mul):
+            pairs = wires.reshape(wires.shape[:-2] + (g.count, 2, wires.shape[-1]))
+            return f.sum_mod(f.mul(pairs[..., 0, :], pairs[..., 1, :]), axis=-1)
+        raise NotImplementedError(type(g))
+
+    # -- query / decide --------------------------------------------------
+
+    def query(self, meas_share, proof_share, query_rand, joint_rand, num_shares: int):
+        """Batched flp.query.
+
+        meas_share [..., MEAS_LEN, L], proof_share [..., PROOF_LEN, L],
+        query_rand [..., 1, L], joint_rand [..., JOINT_RAND_LEN, L] (all in
+        the field module's internal form) ->
+        (verifier [..., VERIFIER_LEN, L], bad_t [...] bool).
+        """
+        f = self.f
+        A, m, p2 = self.arity, self.calls, self.p2
+        seeds = proof_share[..., :A, :]
+        coeffs = proof_share[..., A : A + self.ncoeffs, :]
+        t = query_rand[..., 0, :]
+
+        wires = self.circuit.wires(meas_share, joint_rand, num_shares)  # [..., m, A, L]
+        gouts = self._gadget_outs(coeffs)  # [..., m, L]
+        v0 = self.circuit.output(gouts, meas_share, joint_rand, num_shares)
+
+        # wire polynomials: evals [seed_w, wire values..., 0...] over the
+        # p2-subgroup -> INTT -> Horner at t
+        wires_t = jnp.swapaxes(wires, -3, -2)  # [..., A, m, L]
+        zpad = jnp.zeros(wires_t.shape[:-2] + (p2 - 1 - m, wires_t.shape[-1]),
+                         dtype=wires_t.dtype)
+        evals = jnp.concatenate([seeds[..., :, None, :], wires_t, zpad], axis=-2)
+        wire_coeffs = f.intt(evals)  # [..., A, p2, L]
+        wire_at_t = _horner(f, wire_coeffs, t[..., None, :], axis=-2)  # [..., A, L]
+
+        gpoly_at_t = _horner(f, coeffs, t, axis=-2)  # [..., L]
+
+        verifier = jnp.concatenate(
+            [v0[..., None, :], wire_at_t, gpoly_at_t[..., None, :]], axis=-2
+        )
+        bad_t = f.eq(f.pow_static(t, p2), f.ones(t.shape[:-1]))
+        return verifier, bad_t
+
+    def decide(self, verifier):
+        """Batched flp.decide: [..., VERIFIER_LEN, L] -> ok [...] bool."""
+        f = self.f
+        A = self.arity
+        v0 = verifier[..., 0, :]
+        wires = verifier[..., 1 : 1 + A, :]
+        y = verifier[..., 1 + A, :]
+        return f.is_zero(v0) & f.eq(self._gadget_eval(wires), y)
+
+    def truncate(self, meas):
+        return self.circuit.truncate(meas)

@@ -1,0 +1,126 @@
+"""Batched FLP query/decide vs the Python oracle (janus_tpu.vdaf.flp)."""
+
+import numpy as np
+import pytest
+
+from janus_tpu.ops.flp_batch import BatchFlp
+from janus_tpu.vdaf.flp import Count, Flp, Histogram, Sum, SumVec
+
+
+def _rand_vec(rng, field, n):
+    return [int.from_bytes(rng.bytes(field.ENCODED_SIZE + 8), "little") % field.MODULUS
+            for _ in range(n)]
+
+
+def _share(rng, field, vec, num_shares=2):
+    """Split a vector into additive shares."""
+    shares = [[0] * len(vec) for _ in range(num_shares)]
+    for i, v in enumerate(vec):
+        acc = 0
+        for s in range(num_shares - 1):
+            r = _rand_vec(rng, field, 1)[0]
+            shares[s][i] = r
+            acc = (acc + r) % field.MODULUS
+        shares[-1][i] = (v - acc) % field.MODULUS
+    return shares
+
+
+CONFIGS = [
+    ("count", Count(), [0, 1, 1]),
+    ("sum8", Sum(8), [0, 1, 200]),
+    ("sumvec", SumVec(3, 2, 2), [[0, 1, 3], [2, 2, 0], [1, 0, 1]]),
+    ("histogram", Histogram(5, 2), [0, 3, 4]),
+]
+
+
+@pytest.mark.parametrize("name,valid,measurements", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_query_and_decide_match_oracle(name, valid, measurements):
+    flp = Flp(valid)
+    bf = BatchFlp(flp)
+    f = bf.f
+    field = flp.field
+    rng = np.random.default_rng(42)
+    num_shares = 2
+
+    meas_shares, proof_shares, query_rands, joint_rands, want_verifiers = [], [], [], [], []
+    for m in measurements:
+        meas = valid.encode(m)
+        prove_rand = _rand_vec(rng, field, flp.PROVE_RAND_LEN)
+        joint_rand = _rand_vec(rng, field, flp.JOINT_RAND_LEN)
+        query_rand = _rand_vec(rng, field, flp.QUERY_RAND_LEN)
+        proof = flp.prove(meas, prove_rand, joint_rand)
+        ms = _share(rng, field, meas, num_shares)
+        ps = _share(rng, field, proof, num_shares)
+        for agg in range(num_shares):
+            meas_shares.append(ms[agg])
+            proof_shares.append(ps[agg])
+            query_rands.append(query_rand)
+            joint_rands.append(joint_rand)
+            want_verifiers.append(
+                flp.query(ms[agg], ps[agg], query_rand, joint_rand, num_shares)
+            )
+
+    verifier, bad_t = bf.query(
+        f.pack(meas_shares),
+        f.pack(proof_shares),
+        f.pack(query_rands),
+        f.pack(joint_rands) if flp.JOINT_RAND_LEN else f.zeros((len(meas_shares), 0)),
+        num_shares,
+    )
+    got = f.unpack(verifier)
+    assert not np.asarray(bad_t).any()
+    for i, want in enumerate(want_verifiers):
+        assert list(got[i]) == want, f"verifier mismatch for share {i}"
+
+    # combined verifier (sum across the two shares of each report) passes decide
+    comb = verifier.reshape((len(measurements), num_shares) + verifier.shape[1:])
+    total = f.add(comb[:, 0], comb[:, 1])
+    ok = np.asarray(bf.decide(total))
+    assert ok.all()
+    for i in range(len(measurements)):
+        want_total = [
+            sum(ws) % field.MODULUS
+            for ws in zip(*want_verifiers[i * num_shares : (i + 1) * num_shares])
+        ]
+        assert flp.decide(want_total)
+
+    # tampered proof -> decide False (flip one coefficient of report 0 share 0)
+    tampered = list(proof_shares[0])
+    tampered[bf.arity] = (tampered[bf.arity] + 1) % field.MODULUS
+    bad_ver, _ = bf.query(
+        f.pack([meas_shares[0]]),
+        f.pack([tampered]),
+        f.pack([query_rands[0]]),
+        f.pack([joint_rands[0]]) if flp.JOINT_RAND_LEN else f.zeros((1, 0)),
+        num_shares,
+    )
+    bad_total = f.add(bad_ver[0], verifier.reshape(
+        (len(measurements), num_shares) + verifier.shape[1:])[0, 1])
+    assert not bool(np.asarray(bf.decide(bad_total[None])).item())
+
+
+@pytest.mark.parametrize("name,valid,measurements", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_truncate_matches_oracle(name, valid, measurements):
+    flp = Flp(valid)
+    bf = BatchFlp(flp)
+    f = bf.f
+    encoded = [valid.encode(m) for m in measurements]
+    got = f.unpack(bf.truncate(f.pack(encoded)))
+    for i, e in enumerate(encoded):
+        assert list(got[i]) == valid.truncate(e)
+
+
+def test_bad_t_flag():
+    flp = Flp(Count())
+    bf = BatchFlp(flp)
+    f = bf.f
+    # t = 1 is in the evaluation domain (1^p2 == 1): flag must fire.
+    meas = f.pack([[1]])
+    proof = f.pack([[0] * flp.PROOF_LEN])
+    t_good = f.pack([[12345]])
+    t_bad = f.pack([[1]])
+    jr = f.zeros((1, 0))
+    _, bad = bf.query(meas, proof, t_good, jr, 2)
+    assert not bool(np.asarray(bad).item())
+    _, bad = bf.query(meas, proof, t_bad, jr, 2)
+    assert bool(np.asarray(bad).item())
