@@ -1,0 +1,1017 @@
+"""DAP wire messages (draft-ietf-ppm-dap-09).
+
+The complete message surface of the reference's janus_messages crate
+(messages/src/lib.rs — SURVEY.md §2.2), re-expressed as Python dataclasses
+over the TLS-syntax codec in janus_tpu.messages.codec.  Byte layouts are
+wire-compatible with the reference (validated against its golden test
+vectors in tests/test_messages.py).
+
+Query-type genericity: where the reference threads `Q: QueryType` compile-time
+generics through the stack, here the two query types are singleton descriptor
+objects (TIME_INTERVAL / FIXED_SIZE) passed to decode and stored on decoded
+values; the type-level guarantees become runtime validation at the same
+boundaries.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass, field
+
+from janus_tpu.messages.codec import (
+    Cursor,
+    DecodeError,
+    WireMessage,
+    decode_vec16,
+    decode_vec32,
+    encode_vec16,
+    encode_vec32,
+    opaque8,
+    opaque16,
+    opaque32,
+    u8,
+    u16,
+    u32,
+    u64,
+)
+
+__all__ = [
+    "DecodeError", "Duration", "Time", "Interval", "BatchId", "ReportId",
+    "ReportIdChecksum", "Role", "TaskId", "HpkeConfigId", "HpkeKemId",
+    "HpkeKdfId", "HpkeAeadId", "HpkeCiphertext", "HpkePublicKey", "HpkeConfig",
+    "HpkeConfigList", "ExtensionType", "Extension", "ReportMetadata",
+    "PlaintextInputShare", "Report", "Query", "FixedSizeQuery", "CollectionReq",
+    "PartialBatchSelector", "CollectionJobId", "Collection", "InputShareAad",
+    "AggregateShareAad", "TIME_INTERVAL", "FIXED_SIZE", "ReportShare",
+    "PrepareInit", "PrepareResp", "PrepareStepResult", "PrepareError",
+    "PrepareContinue", "AggregationJobId", "AggregationJobInitializeReq",
+    "AggregationJobStep", "AggregationJobContinueReq", "AggregationJobResp",
+    "BatchSelector", "AggregateShareReq", "AggregateShare",
+]
+
+
+def _b64url_encode(data: bytes) -> str:
+    import base64
+
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(s: str, want_len: int, what: str) -> bytes:
+    import base64
+
+    pad = "=" * (-len(s) % 4)
+    try:
+        out = base64.urlsafe_b64decode(s + pad)
+    except Exception as e:
+        raise ValueError(f"invalid base64url value for {what}") from e
+    if len(out) != want_len:
+        raise ValueError(f"byte slice has incorrect length for {what}")
+    return out
+
+
+class _FixedBytes(WireMessage):
+    """Fixed-size byte-array newtype (TaskId, ReportId, ...)."""
+
+    SIZE: int
+
+    def __init__(self, data: bytes):
+        if len(data) != self.SIZE:
+            raise ValueError(
+                f"byte slice has incorrect length for {type(self).__name__}"
+            )
+        self._data = bytes(data)
+
+    def __bytes__(self) -> bytes:
+        return self._data
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and self._data == other._data
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self._data))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self})"
+
+    def __str__(self) -> str:
+        return _b64url_encode(self._data)
+
+    @classmethod
+    def from_str(cls, s: str):
+        return cls(_b64url_decode(s, cls.SIZE, cls.__name__))
+
+    @classmethod
+    def random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    def encode(self) -> bytes:
+        return self._data
+
+    @classmethod
+    def decode_from(cls, cur: Cursor):
+        return cls(cur.take(cls.SIZE))
+
+
+class TaskId(_FixedBytes):
+    SIZE = 32
+
+
+class BatchId(_FixedBytes):
+    SIZE = 32
+
+
+class ReportId(_FixedBytes):
+    SIZE = 16
+
+
+class AggregationJobId(_FixedBytes):
+    SIZE = 16
+
+
+class CollectionJobId(_FixedBytes):
+    SIZE = 16
+
+
+class ReportIdChecksum(_FixedBytes):
+    """XOR of SHA-256 digests of report IDs (reference messages lib.rs:442)."""
+
+    SIZE = 32
+
+    @classmethod
+    def zero(cls) -> "ReportIdChecksum":
+        return cls(bytes(cls.SIZE))
+
+    def updated_with(self, report_id: ReportId) -> "ReportIdChecksum":
+        import hashlib
+
+        digest = hashlib.sha256(bytes(report_id)).digest()
+        return ReportIdChecksum(bytes(a ^ b for a, b in zip(self._data, digest)))
+
+    def combined(self, other: "ReportIdChecksum") -> "ReportIdChecksum":
+        return ReportIdChecksum(bytes(a ^ b for a, b in zip(self._data, bytes(other))))
+
+
+@dataclass(frozen=True, order=True)
+class Duration(WireMessage):
+    """u64 seconds (reference messages lib.rs:128)."""
+
+    seconds: int
+
+    ZERO: "Duration" = None  # set below
+
+    def encode(self) -> bytes:
+        return u64(self.seconds)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "Duration":
+        return cls(cur.u64())
+
+
+Duration.ZERO = Duration(0)
+
+
+@dataclass(frozen=True, order=True)
+class Time(WireMessage):
+    """u64 seconds since the UNIX epoch (reference messages lib.rs:168)."""
+
+    seconds: int
+
+    def encode(self) -> bytes:
+        return u64(self.seconds)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "Time":
+        return cls(cur.u64())
+
+    # -- arithmetic (validated, mirroring TimeExt/DurationExt semantics) --
+
+    def add(self, d: Duration) -> "Time":
+        out = self.seconds + d.seconds
+        if out >= 1 << 64:
+            raise ValueError("time overflow")
+        return Time(out)
+
+    def sub(self, d: Duration) -> "Time":
+        if self.seconds < d.seconds:
+            raise ValueError("time underflow")
+        return Time(self.seconds - d.seconds)
+
+    def round_down(self, precision: Duration) -> "Time":
+        if precision.seconds == 0:
+            raise ValueError("zero time precision")
+        return Time(self.seconds - self.seconds % precision.seconds)
+
+    def round_up(self, precision: Duration) -> "Time":
+        rounded = self.round_down(precision)
+        if rounded == self:
+            return self
+        return rounded.add(precision)
+
+    def difference(self, other: "Time") -> Duration:
+        if self.seconds < other.seconds:
+            raise ValueError("time underflow")
+        return Duration(self.seconds - other.seconds)
+
+    def is_after(self, other: "Time") -> bool:
+        return self.seconds > other.seconds
+
+    def is_before(self, other: "Time") -> bool:
+        return self.seconds < other.seconds
+
+
+@dataclass(frozen=True)
+class Interval(WireMessage):
+    """Half-open interval [start, start+duration); validated non-overflowing
+    (reference messages lib.rs:219)."""
+
+    start: Time
+    duration: Duration
+
+    def __post_init__(self):
+        if self.start.seconds + self.duration.seconds >= 1 << 64:
+            raise ValueError("interval overflow")
+
+    def end(self) -> Time:
+        return Time(self.start.seconds + self.duration.seconds)
+
+    def contains(self, t: Time) -> bool:
+        return self.start <= t < self.end()
+
+    def contains_interval(self, other: "Interval") -> bool:
+        return self.start <= other.start and other.end() <= self.end()
+
+    def overlaps(self, other: "Interval") -> bool:
+        return self.start < other.end() and other.start < self.end()
+
+    @classmethod
+    def spanning(cls, a: "Interval", b: "Interval") -> "Interval":
+        start = min(a.start, b.start)
+        end = max(a.end(), b.end())
+        return cls(start, Duration(end.seconds - start.seconds))
+
+    @classmethod
+    def for_time(cls, t: Time, precision: Duration) -> "Interval":
+        """The single-precision-unit interval containing t."""
+        return cls(t.round_down(precision), precision)
+
+    def encode(self) -> bytes:
+        return self.start.encode() + self.duration.encode()
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "Interval":
+        start = Time.decode_from(cur)
+        return cls(start, Duration.decode_from(cur))
+
+
+class Role(enum.IntEnum):
+    """Protocol participant (reference messages lib.rs:512)."""
+
+    COLLECTOR = 0
+    CLIENT = 1
+    LEADER = 2
+    HELPER = 3
+
+    def is_aggregator(self) -> bool:
+        return self in (Role.LEADER, Role.HELPER)
+
+    def index(self) -> int:
+        """Aggregator index: leader 0, helper 1."""
+        if not self.is_aggregator():
+            raise ValueError("not an aggregator role")
+        return 0 if self is Role.LEADER else 1
+
+    def encode(self) -> bytes:
+        return u8(int(self))
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "Role":
+        v = cur.u8()
+        try:
+            return cls(v)
+        except ValueError as e:
+            raise DecodeError(f"unknown role {v}") from e
+
+
+@dataclass(frozen=True, order=True)
+class HpkeConfigId(WireMessage):
+    value: int
+
+    def encode(self) -> bytes:
+        return u8(self.value)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "HpkeConfigId":
+        return cls(cur.u8())
+
+
+class _U16Enum:
+    """u16 code with passthrough for unrecognized values (Other in the ref)."""
+
+    KNOWN: dict[int, str] = {}
+
+    def __init__(self, code: int):
+        if not 0 <= code < 1 << 16:
+            raise ValueError("code out of range")
+        self.code = code
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.code == other.code
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.code))
+
+    def __repr__(self):
+        name = self.KNOWN.get(self.code, "Other")
+        return f"{type(self).__name__}({name}:{self.code:#06x})"
+
+    @property
+    def is_known(self) -> bool:
+        return self.code in self.KNOWN
+
+    def encode(self) -> bytes:
+        return u16(self.code)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor):
+        return cls(cur.u16())
+
+
+class HpkeKemId(_U16Enum):
+    KNOWN = {0x0010: "P256HkdfSha256", 0x0020: "X25519HkdfSha256"}
+
+
+HpkeKemId.P256_HKDF_SHA256 = HpkeKemId(0x0010)
+HpkeKemId.X25519_HKDF_SHA256 = HpkeKemId(0x0020)
+
+
+class HpkeKdfId(_U16Enum):
+    KNOWN = {0x0001: "HkdfSha256", 0x0002: "HkdfSha384", 0x0003: "HkdfSha512"}
+
+
+HpkeKdfId.HKDF_SHA256 = HpkeKdfId(0x0001)
+HpkeKdfId.HKDF_SHA384 = HpkeKdfId(0x0002)
+HpkeKdfId.HKDF_SHA512 = HpkeKdfId(0x0003)
+
+
+class HpkeAeadId(_U16Enum):
+    KNOWN = {0x0001: "Aes128Gcm", 0x0002: "Aes256Gcm", 0x0003: "ChaCha20Poly1305"}
+
+
+HpkeAeadId.AES_128_GCM = HpkeAeadId(0x0001)
+HpkeAeadId.AES_256_GCM = HpkeAeadId(0x0002)
+HpkeAeadId.CHACHA20_POLY1305 = HpkeAeadId(0x0003)
+
+
+@dataclass(frozen=True)
+class HpkePublicKey(WireMessage):
+    data: bytes
+
+    def encode(self) -> bytes:
+        return opaque16(self.data)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "HpkePublicKey":
+        return cls(cur.opaque16())
+
+    def __str__(self) -> str:
+        return _b64url_encode(self.data)
+
+
+@dataclass(frozen=True)
+class HpkeConfig(WireMessage):
+    MEDIA_TYPE = "application/dap-hpke-config-list"  # served as a list
+
+    id: HpkeConfigId
+    kem_id: HpkeKemId
+    kdf_id: HpkeKdfId
+    aead_id: HpkeAeadId
+    public_key: HpkePublicKey
+
+    def encode(self) -> bytes:
+        return (self.id.encode() + self.kem_id.encode() + self.kdf_id.encode()
+                + self.aead_id.encode() + self.public_key.encode())
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "HpkeConfig":
+        return cls(
+            HpkeConfigId.decode_from(cur),
+            HpkeKemId.decode_from(cur),
+            HpkeKdfId.decode_from(cur),
+            HpkeAeadId.decode_from(cur),
+            HpkePublicKey.decode_from(cur),
+        )
+
+
+@dataclass(frozen=True)
+class HpkeConfigList(WireMessage):
+    MEDIA_TYPE = "application/dap-hpke-config-list"
+
+    configs: tuple[HpkeConfig, ...]
+
+    def encode(self) -> bytes:
+        return encode_vec16(self.configs)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "HpkeConfigList":
+        return cls(tuple(decode_vec16(cur, HpkeConfig.decode_from)))
+
+
+@dataclass(frozen=True)
+class HpkeCiphertext(WireMessage):
+    config_id: HpkeConfigId
+    encapsulated_key: bytes
+    payload: bytes
+
+    def encode(self) -> bytes:
+        return (self.config_id.encode() + opaque16(self.encapsulated_key)
+                + opaque32(self.payload))
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "HpkeCiphertext":
+        return cls(HpkeConfigId.decode_from(cur), cur.opaque16(), cur.opaque32())
+
+
+class ExtensionType(_U16Enum):
+    KNOWN = {0x0000: "Tbd", 0xFF00: "Taskprov"}
+
+
+ExtensionType.TBD = ExtensionType(0x0000)
+ExtensionType.TASKPROV = ExtensionType(0xFF00)
+
+
+@dataclass(frozen=True)
+class Extension(WireMessage):
+    extension_type: ExtensionType
+    extension_data: bytes
+
+    def encode(self) -> bytes:
+        return self.extension_type.encode() + opaque16(self.extension_data)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "Extension":
+        return cls(ExtensionType.decode_from(cur), cur.opaque16())
+
+
+@dataclass(frozen=True)
+class ReportMetadata(WireMessage):
+    report_id: ReportId
+    time: Time
+
+    def encode(self) -> bytes:
+        return self.report_id.encode() + self.time.encode()
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "ReportMetadata":
+        return cls(ReportId.decode_from(cur), Time.decode_from(cur))
+
+
+@dataclass(frozen=True)
+class PlaintextInputShare(WireMessage):
+    extensions: tuple[Extension, ...]
+    payload: bytes
+
+    def encode(self) -> bytes:
+        return encode_vec16(self.extensions) + opaque32(self.payload)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "PlaintextInputShare":
+        return cls(tuple(decode_vec16(cur, Extension.decode_from)), cur.opaque32())
+
+
+@dataclass(frozen=True)
+class Report(WireMessage):
+    MEDIA_TYPE = "application/dap-report"
+
+    metadata: ReportMetadata
+    public_share: bytes
+    leader_encrypted_input_share: HpkeCiphertext
+    helper_encrypted_input_share: HpkeCiphertext
+
+    def encode(self) -> bytes:
+        return (self.metadata.encode() + opaque32(self.public_share)
+                + self.leader_encrypted_input_share.encode()
+                + self.helper_encrypted_input_share.encode())
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "Report":
+        return cls(
+            ReportMetadata.decode_from(cur),
+            cur.opaque32(),
+            HpkeCiphertext.decode_from(cur),
+            HpkeCiphertext.decode_from(cur),
+        )
+
+
+# ---------------------------------------------------------------------------
+# query types
+# ---------------------------------------------------------------------------
+
+
+class QueryType:
+    """Runtime descriptor standing in for the reference's Q generic
+    (messages lib.rs:1970)."""
+
+    CODE: int
+    NAME: str
+
+    def encode_identifier(self, ident) -> bytes:
+        raise NotImplementedError
+
+    def decode_identifier(self, cur: Cursor):
+        raise NotImplementedError
+
+    def encode_partial_identifier(self, ident) -> bytes:
+        raise NotImplementedError
+
+    def decode_partial_identifier(self, cur: Cursor):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.NAME
+
+
+class _TimeInterval(QueryType):
+    CODE = 1
+    NAME = "TimeInterval"
+
+    # batch identifier: Interval; partial identifier: () (unit)
+    def encode_identifier(self, ident: Interval) -> bytes:
+        return ident.encode()
+
+    def decode_identifier(self, cur: Cursor) -> Interval:
+        return Interval.decode_from(cur)
+
+    def encode_partial_identifier(self, ident) -> bytes:
+        return b""
+
+    def decode_partial_identifier(self, cur: Cursor):
+        return None
+
+
+class _FixedSize(QueryType):
+    CODE = 2
+    NAME = "FixedSize"
+
+    # batch identifier and partial identifier: BatchId
+    def encode_identifier(self, ident: BatchId) -> bytes:
+        return ident.encode()
+
+    def decode_identifier(self, cur: Cursor) -> BatchId:
+        return BatchId.decode_from(cur)
+
+    def encode_partial_identifier(self, ident: BatchId) -> bytes:
+        return ident.encode()
+
+    def decode_partial_identifier(self, cur: Cursor) -> BatchId:
+        return BatchId.decode_from(cur)
+
+
+TIME_INTERVAL = _TimeInterval()
+FIXED_SIZE = _FixedSize()
+QUERY_TYPES = {1: TIME_INTERVAL, 2: FIXED_SIZE}
+
+
+def _decode_query_type(cur: Cursor, expect: QueryType | None) -> QueryType:
+    code = cur.u8()
+    qt = QUERY_TYPES.get(code)
+    if qt is None:
+        raise DecodeError(f"unknown query type {code}")
+    if expect is not None and qt is not expect:
+        raise DecodeError(f"unexpected query type {qt} (wanted {expect})")
+    return qt
+
+
+@dataclass(frozen=True)
+class FixedSizeQuery(WireMessage):
+    BY_BATCH_ID = 0
+    CURRENT_BATCH = 1
+
+    kind: int
+    batch_id: BatchId | None = None
+
+    def encode(self) -> bytes:
+        if self.kind == self.BY_BATCH_ID:
+            return u8(0) + self.batch_id.encode()
+        return u8(1)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "FixedSizeQuery":
+        kind = cur.u8()
+        if kind == cls.BY_BATCH_ID:
+            return cls(kind, BatchId.decode_from(cur))
+        if kind == cls.CURRENT_BATCH:
+            return cls(kind)
+        raise DecodeError(f"unknown fixed-size query type {kind}")
+
+
+@dataclass(frozen=True)
+class Query(WireMessage):
+    """A collector query; body depends on query type (messages lib.rs:1479)."""
+
+    query_type: QueryType
+    # TimeInterval: Interval; FixedSize: FixedSizeQuery
+    query_body: object
+
+    def encode(self) -> bytes:
+        return u8(self.query_type.CODE) + self.query_body.encode()
+
+    @classmethod
+    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None) -> "Query":
+        qt = _decode_query_type(cur, expect)
+        if qt is TIME_INTERVAL:
+            return cls(qt, Interval.decode_from(cur))
+        return cls(qt, FixedSizeQuery.decode_from(cur))
+
+    decode_from = decode_expecting
+
+    @classmethod
+    def time_interval(cls, batch_interval: Interval) -> "Query":
+        return cls(TIME_INTERVAL, batch_interval)
+
+    @classmethod
+    def fixed_size(cls, fixed_size_query: FixedSizeQuery) -> "Query":
+        return cls(FIXED_SIZE, fixed_size_query)
+
+
+@dataclass(frozen=True)
+class CollectionReq(WireMessage):
+    MEDIA_TYPE = "application/dap-collect-req"
+
+    query: Query
+    aggregation_parameter: bytes = b""
+
+    def encode(self) -> bytes:
+        return self.query.encode() + opaque32(self.aggregation_parameter)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "CollectionReq":
+        return cls(Query.decode_expecting(cur), cur.opaque32())
+
+
+@dataclass(frozen=True)
+class PartialBatchSelector(WireMessage):
+    """Identifies a batch mid-aggregation (messages lib.rs:1606): unit for
+    TimeInterval, the batch id for FixedSize."""
+
+    query_type: QueryType
+    batch_identifier: object = None  # None | BatchId
+
+    def encode(self) -> bytes:
+        return u8(self.query_type.CODE) + self.query_type.encode_partial_identifier(
+            self.batch_identifier
+        )
+
+    @classmethod
+    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+        qt = _decode_query_type(cur, expect)
+        return cls(qt, qt.decode_partial_identifier(cur))
+
+    decode_from = decode_expecting
+
+    @classmethod
+    def time_interval(cls) -> "PartialBatchSelector":
+        return cls(TIME_INTERVAL)
+
+    @classmethod
+    def fixed_size(cls, batch_id: BatchId) -> "PartialBatchSelector":
+        return cls(FIXED_SIZE, batch_id)
+
+
+@dataclass(frozen=True)
+class Collection(WireMessage):
+    MEDIA_TYPE = "application/dap-collection"
+
+    partial_batch_selector: PartialBatchSelector
+    report_count: int
+    interval: Interval
+    leader_encrypted_agg_share: HpkeCiphertext
+    helper_encrypted_agg_share: HpkeCiphertext
+
+    def encode(self) -> bytes:
+        return (self.partial_batch_selector.encode() + u64(self.report_count)
+                + self.interval.encode() + self.leader_encrypted_agg_share.encode()
+                + self.helper_encrypted_agg_share.encode())
+
+    @classmethod
+    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+        return cls(
+            PartialBatchSelector.decode_expecting(cur, expect),
+            cur.u64(),
+            Interval.decode_from(cur),
+            HpkeCiphertext.decode_from(cur),
+            HpkeCiphertext.decode_from(cur),
+        )
+
+    decode_from = decode_expecting
+
+
+@dataclass(frozen=True)
+class InputShareAad(WireMessage):
+    """HPKE AAD for input shares (messages lib.rs:1821)."""
+
+    task_id: TaskId
+    metadata: ReportMetadata
+    public_share: bytes
+
+    def encode(self) -> bytes:
+        return (self.task_id.encode() + self.metadata.encode()
+                + opaque32(self.public_share))
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "InputShareAad":
+        return cls(TaskId.decode_from(cur), ReportMetadata.decode_from(cur),
+                   cur.opaque32())
+
+
+@dataclass(frozen=True)
+class AggregateShareAad(WireMessage):
+    """HPKE AAD for aggregate shares (messages lib.rs:1887)."""
+
+    task_id: TaskId
+    aggregation_parameter: bytes
+    batch_selector: "BatchSelector"
+
+    def encode(self) -> bytes:
+        return (self.task_id.encode() + opaque32(self.aggregation_parameter)
+                + self.batch_selector.encode())
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "AggregateShareAad":
+        return cls(TaskId.decode_from(cur), cur.opaque32(),
+                   BatchSelector.decode_expecting(cur))
+
+
+# ---------------------------------------------------------------------------
+# aggregation sub-protocol
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ReportShare(WireMessage):
+    metadata: ReportMetadata
+    public_share: bytes
+    encrypted_input_share: HpkeCiphertext
+
+    def encode(self) -> bytes:
+        return (self.metadata.encode() + opaque32(self.public_share)
+                + self.encrypted_input_share.encode())
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "ReportShare":
+        return cls(ReportMetadata.decode_from(cur), cur.opaque32(),
+                   HpkeCiphertext.decode_from(cur))
+
+
+class PrepareError(enum.IntEnum):
+    """Per-report rejection reasons (messages lib.rs:2338)."""
+
+    BATCH_COLLECTED = 0
+    REPORT_REPLAYED = 1
+    REPORT_DROPPED = 2
+    HPKE_UNKNOWN_CONFIG_ID = 3
+    HPKE_DECRYPT_ERROR = 4
+    VDAF_PREP_ERROR = 5
+    BATCH_SATURATED = 6
+    TASK_EXPIRED = 7
+    INVALID_MESSAGE = 8
+    REPORT_TOO_EARLY = 9
+
+    def encode(self) -> bytes:
+        return u8(int(self))
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "PrepareError":
+        v = cur.u8()
+        try:
+            return cls(v)
+        except ValueError as e:
+            raise DecodeError(f"unknown prepare error {v}") from e
+
+
+@dataclass(frozen=True)
+class PrepareInit(WireMessage):
+    """Report share + leader's first ping-pong message (messages lib.rs:2185)."""
+
+    report_share: ReportShare
+    message: bytes  # encoded PingPongMessage, opaque here
+
+    def encode(self) -> bytes:
+        return self.report_share.encode() + opaque32(self.message)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "PrepareInit":
+        return cls(ReportShare.decode_from(cur), cur.opaque32())
+
+
+@dataclass(frozen=True)
+class PrepareStepResult(WireMessage):
+    """Continue(message) | Finished | Reject(error) (messages lib.rs:2283)."""
+
+    CONTINUE = 0
+    FINISHED = 1
+    REJECT = 2
+
+    kind: int
+    message: bytes | None = None  # encoded PingPongMessage for CONTINUE
+    error: PrepareError | None = None
+
+    def encode(self) -> bytes:
+        if self.kind == self.CONTINUE:
+            return u8(0) + opaque32(self.message)
+        if self.kind == self.FINISHED:
+            return u8(1)
+        return u8(2) + self.error.encode()
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "PrepareStepResult":
+        kind = cur.u8()
+        if kind == cls.CONTINUE:
+            return cls(kind, message=cur.opaque32())
+        if kind == cls.FINISHED:
+            return cls(kind)
+        if kind == cls.REJECT:
+            return cls(kind, error=PrepareError.decode_from(cur))
+        raise DecodeError(f"unknown prepare step result {kind}")
+
+    @classmethod
+    def continued(cls, message: bytes) -> "PrepareStepResult":
+        return cls(cls.CONTINUE, message=message)
+
+    @classmethod
+    def finished(cls) -> "PrepareStepResult":
+        return cls(cls.FINISHED)
+
+    @classmethod
+    def rejected(cls, error: PrepareError) -> "PrepareStepResult":
+        return cls(cls.REJECT, error=error)
+
+
+@dataclass(frozen=True)
+class PrepareResp(WireMessage):
+    report_id: ReportId
+    result: PrepareStepResult
+
+    def encode(self) -> bytes:
+        return self.report_id.encode() + self.result.encode()
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "PrepareResp":
+        return cls(ReportId.decode_from(cur), PrepareStepResult.decode_from(cur))
+
+
+@dataclass(frozen=True)
+class PrepareContinue(WireMessage):
+    """Report id + next ping-pong message (messages lib.rs:2373)."""
+
+    report_id: ReportId
+    message: bytes
+
+    def encode(self) -> bytes:
+        return self.report_id.encode() + opaque32(self.message)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "PrepareContinue":
+        return cls(ReportId.decode_from(cur), cur.opaque32())
+
+
+@dataclass(frozen=True)
+class AggregationJobStep(WireMessage):
+    value: int
+
+    def encode(self) -> bytes:
+        return u16(self.value)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "AggregationJobStep":
+        return cls(cur.u16())
+
+    def increment(self) -> "AggregationJobStep":
+        return AggregationJobStep(self.value + 1)
+
+
+@dataclass(frozen=True)
+class AggregationJobInitializeReq(WireMessage):
+    MEDIA_TYPE = "application/dap-aggregation-job-init-req"
+
+    aggregation_parameter: bytes
+    partial_batch_selector: PartialBatchSelector
+    prepare_inits: tuple[PrepareInit, ...]
+
+    def encode(self) -> bytes:
+        return (opaque32(self.aggregation_parameter)
+                + self.partial_batch_selector.encode()
+                + encode_vec32(self.prepare_inits))
+
+    @classmethod
+    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+        return cls(
+            cur.opaque32(),
+            PartialBatchSelector.decode_expecting(cur, expect),
+            tuple(decode_vec32(cur, PrepareInit.decode_from)),
+        )
+
+    decode_from = decode_expecting
+
+
+@dataclass(frozen=True)
+class AggregationJobContinueReq(WireMessage):
+    MEDIA_TYPE = "application/dap-aggregation-job-continue-req"
+
+    step: AggregationJobStep
+    prepare_continues: tuple[PrepareContinue, ...]
+
+    def encode(self) -> bytes:
+        return self.step.encode() + encode_vec32(self.prepare_continues)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "AggregationJobContinueReq":
+        return cls(AggregationJobStep.decode_from(cur),
+                   tuple(decode_vec32(cur, PrepareContinue.decode_from)))
+
+
+@dataclass(frozen=True)
+class AggregationJobResp(WireMessage):
+    MEDIA_TYPE = "application/dap-aggregation-job-resp"
+
+    prepare_resps: tuple[PrepareResp, ...]
+
+    def encode(self) -> bytes:
+        return encode_vec32(self.prepare_resps)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "AggregationJobResp":
+        return cls(tuple(decode_vec32(cur, PrepareResp.decode_from)))
+
+
+# ---------------------------------------------------------------------------
+# aggregate-share sub-protocol
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BatchSelector(WireMessage):
+    """Identifies a batch for collection (messages lib.rs:2711): the interval
+    for TimeInterval, the batch id for FixedSize."""
+
+    query_type: QueryType
+    batch_identifier: object  # Interval | BatchId
+
+    def encode(self) -> bytes:
+        return u8(self.query_type.CODE) + self.query_type.encode_identifier(
+            self.batch_identifier
+        )
+
+    @classmethod
+    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+        qt = _decode_query_type(cur, expect)
+        return cls(qt, qt.decode_identifier(cur))
+
+    decode_from = decode_expecting
+
+    @classmethod
+    def time_interval(cls, batch_interval: Interval) -> "BatchSelector":
+        return cls(TIME_INTERVAL, batch_interval)
+
+    @classmethod
+    def fixed_size(cls, batch_id: BatchId) -> "BatchSelector":
+        return cls(FIXED_SIZE, batch_id)
+
+
+@dataclass(frozen=True)
+class AggregateShareReq(WireMessage):
+    MEDIA_TYPE = "application/dap-aggregate-share-req"
+
+    batch_selector: BatchSelector
+    aggregation_parameter: bytes
+    report_count: int
+    checksum: ReportIdChecksum
+
+    def encode(self) -> bytes:
+        return (self.batch_selector.encode() + opaque32(self.aggregation_parameter)
+                + u64(self.report_count) + self.checksum.encode())
+
+    @classmethod
+    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+        return cls(
+            BatchSelector.decode_expecting(cur, expect),
+            cur.opaque32(),
+            cur.u64(),
+            ReportIdChecksum.decode_from(cur),
+        )
+
+    decode_from = decode_expecting
+
+
+@dataclass(frozen=True)
+class AggregateShare(WireMessage):
+    MEDIA_TYPE = "application/dap-aggregate-share"
+
+    encrypted_aggregate_share: HpkeCiphertext
+
+    def encode(self) -> bytes:
+        return self.encrypted_aggregate_share.encode()
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "AggregateShare":
+        return cls(HpkeCiphertext.decode_from(cur))

@@ -1,0 +1,143 @@
+"""TLS-syntax codec framework for DAP wire messages.
+
+The encoding discipline of draft-ietf-ppm-dap-09 (and the reference's
+janus_messages, messages/src/lib.rs): big-endian fixed-width integers,
+fixed-size byte arrays, and length-prefixed opaque byte strings with 1-, 2-,
+or 4-byte length prefixes.  Unlike the reference's per-type Encode/Decode
+impls this is a tiny cursor/builder pair; message types compose it.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class DecodeError(ValueError):
+    """Malformed wire bytes."""
+
+
+class Cursor:
+    """A read cursor over an immutable byte buffer."""
+
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+    def take(self, n: int) -> bytes:
+        if self.remaining() < n:
+            raise DecodeError(f"short read: wanted {n}, have {self.remaining()}")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack(">H", self.take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack(">Q", self.take(8))[0]
+
+    def opaque8(self) -> bytes:
+        return self.take(self.u8())
+
+    def opaque16(self) -> bytes:
+        return self.take(self.u16())
+
+    def opaque32(self) -> bytes:
+        return self.take(self.u32())
+
+    def finish(self) -> None:
+        if self.remaining():
+            raise DecodeError(f"{self.remaining()} trailing bytes")
+
+
+def u8(v: int) -> bytes:
+    if not 0 <= v < 1 << 8:
+        raise ValueError("u8 out of range")
+    return bytes([v])
+
+
+def u16(v: int) -> bytes:
+    if not 0 <= v < 1 << 16:
+        raise ValueError("u16 out of range")
+    return struct.pack(">H", v)
+
+
+def u32(v: int) -> bytes:
+    if not 0 <= v < 1 << 32:
+        raise ValueError("u32 out of range")
+    return struct.pack(">I", v)
+
+
+def u64(v: int) -> bytes:
+    if not 0 <= v < 1 << 64:
+        raise ValueError("u64 out of range")
+    return struct.pack(">Q", v)
+
+
+def opaque8(data: bytes) -> bytes:
+    return u8(len(data)) + data
+
+
+def opaque16(data: bytes) -> bytes:
+    return u16(len(data)) + data
+
+
+def opaque32(data: bytes) -> bytes:
+    return u32(len(data)) + data
+
+
+def encode_vec16(items) -> bytes:
+    """u16-byte-length-prefixed concatenation of encoded items."""
+    body = b"".join(item.encode() for item in items)
+    return u16(len(body)) + body
+
+
+def encode_vec32(items) -> bytes:
+    """u32-byte-length-prefixed concatenation of encoded items."""
+    body = b"".join(item.encode() for item in items)
+    return u32(len(body)) + body
+
+
+def decode_vec16(cur: Cursor, decode_one) -> list:
+    body = Cursor(cur.opaque16())
+    out = []
+    while body.remaining():
+        out.append(decode_one(body))
+    return out
+
+
+def decode_vec32(cur: Cursor, decode_one) -> list:
+    body = Cursor(cur.opaque32())
+    out = []
+    while body.remaining():
+        out.append(decode_one(body))
+    return out
+
+
+class WireMessage:
+    """Base: whole-buffer decode with trailing-byte check."""
+
+    def encode(self) -> bytes:
+        raise NotImplementedError
+
+    @classmethod
+    def decode_from(cls, cur: Cursor):
+        raise NotImplementedError
+
+    @classmethod
+    def decode(cls, data: bytes):
+        cur = Cursor(data)
+        out = cls.decode_from(cur)
+        cur.finish()
+        return out

@@ -1,0 +1,41 @@
+"""DAP problem types: the closed enum of urn:ietf:params:ppm:dap:error:* codes
+(reference messages/src/problem_type.rs:7)."""
+
+from __future__ import annotations
+
+import enum
+
+_PREFIX = "urn:ietf:params:ppm:dap:error:"
+
+
+class DapProblemType(enum.Enum):
+    INVALID_MESSAGE = "invalidMessage"
+    UNRECOGNIZED_TASK = "unrecognizedTask"
+    MISSING_TASK_ID = "missingTaskID"
+    UNRECOGNIZED_AGGREGATION_JOB = "unrecognizedAggregationJob"
+    OUTDATED_CONFIG = "outdatedConfig"
+    REPORT_REJECTED = "reportRejected"
+    REPORT_TOO_EARLY = "reportTooEarly"
+    BATCH_INVALID = "batchInvalid"
+    INVALID_BATCH_SIZE = "invalidBatchSize"
+    BATCH_QUERIED_TOO_MANY_TIMES = "batchQueriedTooManyTimes"
+    BATCH_MISMATCH = "batchMismatch"
+    UNAUTHORIZED_REQUEST = "unauthorizedRequest"
+    BATCH_OVERLAP = "batchOverlap"
+    STEP_MISMATCH = "stepMismatch"
+    UNRECOGNIZED_COLLECTION_JOB = "unrecognizedCollectionJob"
+    INVALID_TASK = "invalidTask"
+
+    @property
+    def type_uri(self) -> str:
+        return _PREFIX + self.value
+
+    @classmethod
+    def from_type_uri(cls, uri: str) -> "DapProblemType":
+        if not uri.startswith(_PREFIX):
+            raise ValueError(f"not a DAP problem type: {uri}")
+        return cls(uri[len(_PREFIX):])
+
+    def http_status(self) -> int:
+        """The HTTP status the reference serves this problem with (400 family)."""
+        return 403 if self is DapProblemType.UNAUTHORIZED_REQUEST else 400

@@ -1,0 +1,353 @@
+"""DAP wire-format tests: round trips + golden vectors.
+
+Golden hex vectors are transcribed from the reference's janus_messages test
+suite (messages/src/lib.rs) to pin wire compatibility.
+"""
+
+import pytest
+
+from janus_tpu import messages as m
+from janus_tpu.vdaf.ping_pong import PingPongMessage
+
+
+def roundtrip(val, hex_str=None, decode=None):
+    enc = val.encode()
+    if hex_str is not None:
+        assert enc.hex().upper() == hex_str.replace(" ", "").upper(), (
+            f"encoding mismatch:\n got {enc.hex()}\nwant {hex_str.lower()}"
+        )
+    dec = (decode or type(val).decode)(enc)
+    assert dec == val
+    return enc
+
+
+def test_duration_time_interval():
+    roundtrip(m.Duration(12345), "0000000000003039")
+    roundtrip(m.Time(54321), "000000000000D431")
+    roundtrip(
+        m.Interval(m.Time(54321), m.Duration(12345)),
+        "000000000000D431" "0000000000003039",
+    )
+    with pytest.raises(ValueError):
+        m.Interval(m.Time((1 << 64) - 1), m.Duration(2))
+
+
+def test_interval_helpers():
+    iv = m.Interval(m.Time(100), m.Duration(50))
+    assert iv.contains(m.Time(100)) and iv.contains(m.Time(149))
+    assert not iv.contains(m.Time(150))
+    assert iv.overlaps(m.Interval(m.Time(149), m.Duration(1)))
+    assert not iv.overlaps(m.Interval(m.Time(150), m.Duration(10)))
+    span = m.Interval.spanning(iv, m.Interval(m.Time(200), m.Duration(25)))
+    assert span == m.Interval(m.Time(100), m.Duration(125))
+    assert m.Time(1234).round_down(m.Duration(100)) == m.Time(1200)
+    assert m.Time(1234).round_up(m.Duration(100)) == m.Time(1300)
+
+
+def test_fixed_bytes_types():
+    rid = m.ReportId(bytes(range(1, 17)))
+    roundtrip(rid, "0102030405060708090A0B0C0D0E0F10")
+    assert m.ReportId.from_str(str(rid)) == rid
+    with pytest.raises(ValueError):
+        m.ReportId(b"short")
+    tid = m.TaskId(bytes(32))
+    assert str(tid) == "A" * 43
+    assert m.TaskId.from_str("A" * 43) == tid
+    with pytest.raises(ValueError):
+        m.TaskId.from_str("A" * 42)
+
+
+def test_checksum_xor_of_sha256():
+    # checksum = XOR of SHA256(report id) (reference core/src/report_id.rs)
+    import hashlib
+
+    r1 = m.ReportId(bytes(16))
+    r2 = m.ReportId(bytes(range(16)))
+    ck = m.ReportIdChecksum.zero().updated_with(r1).updated_with(r2)
+    want = bytes(
+        a ^ b
+        for a, b in zip(
+            hashlib.sha256(bytes(r1)).digest(), hashlib.sha256(bytes(r2)).digest()
+        )
+    )
+    assert bytes(ck) == want
+    assert m.ReportIdChecksum.zero().updated_with(r1).combined(
+        m.ReportIdChecksum.zero().updated_with(r2)
+    ) == ck
+
+
+def test_role():
+    assert m.Role.LEADER.index() == 0 and m.Role.HELPER.index() == 1
+    assert m.Role.COLLECTOR == 0 and m.Role.CLIENT == 1
+
+
+def test_hpke_config_golden():
+    roundtrip(
+        m.HpkeConfig(
+            m.HpkeConfigId(12), m.HpkeKemId.P256_HKDF_SHA256, m.HpkeKdfId.HKDF_SHA512,
+            m.HpkeAeadId.AES_256_GCM, m.HpkePublicKey(b""),
+        ),
+        "0C" "0010" "0003" "0002" "0000",
+    )
+    roundtrip(
+        m.HpkeConfig(
+            m.HpkeConfigId(23), m.HpkeKemId.X25519_HKDF_SHA256, m.HpkeKdfId.HKDF_SHA256,
+            m.HpkeAeadId.CHACHA20_POLY1305, m.HpkePublicKey(b"0123456789abcdef"),
+        ),
+        "17" "0020" "0001" "0003" "0010" "30313233343536373839616263646566",
+    )
+    # unknown algorithm ids pass through
+    roundtrip(
+        m.HpkeConfig(
+            m.HpkeConfigId(12), m.HpkeKemId(0x9999), m.HpkeKdfId.HKDF_SHA512,
+            m.HpkeAeadId.AES_256_GCM, m.HpkePublicKey(b""),
+        ),
+        "0C" "9999" "0003" "0002" "0000",
+    )
+
+
+def test_hpke_config_list_golden():
+    cfg = lambda aead: m.HpkeConfig(
+        m.HpkeConfigId(12), m.HpkeKemId.P256_HKDF_SHA256, m.HpkeKdfId.HKDF_SHA512,
+        aead, m.HpkePublicKey(b""),
+    )
+    roundtrip(
+        m.HpkeConfigList((cfg(m.HpkeAeadId.AES_256_GCM), cfg(m.HpkeAeadId(0x9999)))),
+        "0012" "0C" "0010" "0003" "0002" "0000" "0C" "0010" "0003" "9999" "0000",
+    )
+
+
+def test_report_golden():
+    report = m.Report(
+        m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(12345)),
+        b"",
+        m.HpkeCiphertext(m.HpkeConfigId(42), b"012345", b"543210"),
+        m.HpkeCiphertext(m.HpkeConfigId(13), b"abce", b"abfd"),
+    )
+    roundtrip(
+        report,
+        "0102030405060708090A0B0C0D0E0F10" "0000000000003039"
+        "00000000"
+        "2A" "0006" "303132333435" "00000006" "353433323130"
+        "0D" "0004" "61626365" "00000004" "61626664",
+    )
+
+
+def test_plaintext_input_share_golden():
+    roundtrip(
+        m.PlaintextInputShare((), b"0123"),
+        "0000" "00000004" "30313233",
+    )
+    roundtrip(
+        m.PlaintextInputShare(
+            (m.Extension(m.ExtensionType.TBD, b"0123"),), b"4567"
+        ),
+        "0008" "0000" "0004" "30313233" "00000004" "34353637",
+    )
+
+
+def test_extension_golden():
+    roundtrip(m.Extension(m.ExtensionType.TBD, b""), "0000" "0000")
+    roundtrip(m.Extension(m.ExtensionType.TASKPROV, b"0123"), "FF00" "0004" "30313233")
+
+
+def test_query_golden():
+    roundtrip(
+        m.Query.time_interval(m.Interval(m.Time(54321), m.Duration(12345))),
+        "01" "000000000000D431" "0000000000003039",
+        decode=lambda d: m.Query.decode(d),
+    )
+    roundtrip(
+        m.Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.BY_BATCH_ID,
+                                            m.BatchId(bytes([10] * 32)))),
+        "02" "00" + "0A" * 32,
+    )
+    roundtrip(m.Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.CURRENT_BATCH)),
+              "02" "01")
+
+
+def test_prepare_init_golden():
+    pi = m.PrepareInit(
+        m.ReportShare(
+            m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(54321)),
+            b"",
+            m.HpkeCiphertext(m.HpkeConfigId(42), b"012345", b"543210"),
+        ),
+        PingPongMessage(PingPongMessage.TYPE_INITIALIZE, prep_share=b"012345").encode(),
+    )
+    roundtrip(
+        pi,
+        "0102030405060708090A0B0C0D0E0F10" "000000000000D431"
+        "00000000"
+        "2A" "0006" "303132333435" "00000006" "353433323130"
+        "0000000b" "00" "00000006" "303132333435",
+    )
+
+
+def test_prepare_resp_golden():
+    roundtrip(
+        m.PrepareResp(
+            m.ReportId(bytes(range(1, 17))),
+            m.PrepareStepResult.continued(
+                PingPongMessage(PingPongMessage.TYPE_CONTINUE, prep_msg=b"012345",
+                                prep_share=b"6789").encode()
+            ),
+        ),
+        "0102030405060708090A0B0C0D0E0F10" "00" "00000013" "01"
+        "00000006" "303132333435" "00000004" "36373839",
+    )
+    roundtrip(
+        m.PrepareResp(m.ReportId(bytes(range(16, 0, -1))), m.PrepareStepResult.finished()),
+        "100F0E0D0C0B0A090807060504030201" "01",
+    )
+    roundtrip(
+        m.PrepareResp(
+            m.ReportId(bytes([255] * 16)),
+            m.PrepareStepResult.rejected(m.PrepareError.VDAF_PREP_ERROR),
+        ),
+        "FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF" "02" "05",
+    )
+
+
+def test_prepare_error_codes():
+    for err, code in [
+        (m.PrepareError.BATCH_COLLECTED, 0), (m.PrepareError.REPORT_REPLAYED, 1),
+        (m.PrepareError.REPORT_DROPPED, 2), (m.PrepareError.HPKE_UNKNOWN_CONFIG_ID, 3),
+        (m.PrepareError.HPKE_DECRYPT_ERROR, 4), (m.PrepareError.VDAF_PREP_ERROR, 5),
+        (m.PrepareError.BATCH_SATURATED, 6), (m.PrepareError.TASK_EXPIRED, 7),
+        (m.PrepareError.INVALID_MESSAGE, 8), (m.PrepareError.REPORT_TOO_EARLY, 9),
+    ]:
+        assert int(err) == code
+
+
+def test_aggregation_job_initialize_req_golden():
+    req = m.AggregationJobInitializeReq(
+        b"012345",
+        m.PartialBatchSelector.time_interval(),
+        (
+            m.PrepareInit(
+                m.ReportShare(
+                    m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(54321)),
+                    b"",
+                    m.HpkeCiphertext(m.HpkeConfigId(42), b"012345", b"543210"),
+                ),
+                PingPongMessage(PingPongMessage.TYPE_INITIALIZE,
+                                prep_share=b"012345").encode(),
+            ),
+            m.PrepareInit(
+                m.ReportShare(
+                    m.ReportMetadata(m.ReportId(bytes(range(16, 0, -1))), m.Time(73542)),
+                    b"0123",
+                    m.HpkeCiphertext(m.HpkeConfigId(13), b"abce", b"abfd"),
+                ),
+                PingPongMessage(PingPongMessage.TYPE_FINISH, prep_msg=b"").encode(),
+            ),
+        ),
+    )
+    enc = roundtrip(req, decode=lambda d: m.AggregationJobInitializeReq.decode(d))
+    assert enc.startswith(bytes.fromhex("00000006303132333435" "01" "00000076"))
+
+
+def test_aggregation_job_resp_golden():
+    resp = m.AggregationJobResp((
+        m.PrepareResp(
+            m.ReportId(bytes(range(1, 17))),
+            m.PrepareStepResult.continued(
+                PingPongMessage(PingPongMessage.TYPE_CONTINUE, prep_msg=b"01234",
+                                prep_share=b"56789").encode()),
+        ),
+        m.PrepareResp(m.ReportId(bytes(range(16, 0, -1))),
+                      m.PrepareStepResult.finished()),
+    ))
+    roundtrip(
+        resp,
+        "00000039"
+        "0102030405060708090A0B0C0D0E0F10" "00" "00000013" "01"
+        "00000005" "3031323334" "00000005" "3536373839"
+        "100F0E0D0C0B0A090807060504030201" "01",
+    )
+
+
+def test_aggregate_share_req_golden():
+    req = m.AggregateShareReq(
+        m.BatchSelector.time_interval(m.Interval(m.Time(54321), m.Duration(12345))),
+        b"",
+        439,
+        m.ReportIdChecksum(bytes(32)),
+    )
+    roundtrip(
+        req,
+        "01" "000000000000D431" "0000000000003039"
+        "00000000" "00000000000001B7" + "00" * 32,
+        decode=lambda d: m.AggregateShareReq.decode(d),
+    )
+
+
+def test_collection_golden():
+    col = m.Collection(
+        m.PartialBatchSelector.time_interval(),
+        0,
+        m.Interval(m.Time(54321), m.Duration(12345)),
+        m.HpkeCiphertext(m.HpkeConfigId(10), b"0123", b"4567"),
+        m.HpkeCiphertext(m.HpkeConfigId(12), b"01234", b"567"),
+    )
+    roundtrip(
+        col,
+        "01" "0000000000000000" "000000000000D431" "0000000000003039"
+        "0A" "0004" "30313233" "00000004" "34353637"
+        "0C" "0005" "3031323334" "00000003" "353637",
+        decode=lambda d: m.Collection.decode(d),
+    )
+
+
+def test_aads_golden():
+    roundtrip(
+        m.InputShareAad(
+            m.TaskId(bytes([12] * 32)),
+            m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(54321)),
+            b"0123",
+        ),
+        "0C" * 32 + "0102030405060708090A0B0C0D0E0F10" "000000000000D431"
+        "00000004" "30313233",
+    )
+    roundtrip(
+        m.AggregateShareAad(
+            m.TaskId(bytes([12] * 32)),
+            bytes([0, 1, 2, 3]),
+            m.BatchSelector.time_interval(m.Interval(m.Time(54321), m.Duration(12345))),
+        ),
+        "0C" * 32 + "00000004" "00010203" "01" "000000000000D431" "0000000000003039",
+    )
+    roundtrip(
+        m.AggregateShareAad(
+            m.TaskId(bytes(32)),
+            bytes([3, 2, 1, 0]),
+            m.BatchSelector.fixed_size(m.BatchId(bytes([7] * 32))),
+        ),
+        "00" * 32 + "00000004" "03020100" "02" + "07" * 32,
+    )
+
+
+def test_query_type_mismatch_rejected():
+    enc = m.BatchSelector.time_interval(
+        m.Interval(m.Time(1), m.Duration(2))
+    ).encode()
+    from janus_tpu.messages.codec import Cursor
+
+    with pytest.raises(m.DecodeError):
+        cur = Cursor(enc)
+        m.BatchSelector.decode_expecting(cur, m.FIXED_SIZE)
+
+
+def test_trailing_bytes_rejected():
+    with pytest.raises(m.DecodeError):
+        m.Duration.decode(b"\x00" * 9)
+
+
+def test_problem_types():
+    from janus_tpu.messages.problem_type import DapProblemType
+
+    t = DapProblemType.BATCH_QUERIED_TOO_MANY_TIMES
+    assert t.type_uri == "urn:ietf:params:ppm:dap:error:batchQueriedTooManyTimes"
+    assert DapProblemType.from_type_uri(t.type_uri) is t
+    assert DapProblemType.UNAUTHORIZED_REQUEST.http_status() == 403
