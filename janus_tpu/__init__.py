@@ -22,3 +22,22 @@ A from-scratch re-design of the capabilities of the reference implementation
 """
 
 __version__ = "0.1.0"
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Enable JAX's persistent compilation cache for the VDAF kernels.
+
+    The batch-prepare executables are large (wide field-limb arithmetic);
+    caching them makes every process after the first start in milliseconds.
+    Called by the test suite, bench.py, and the aggregator binaries.
+    """
+    import os
+
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "JANUS_TPU_COMPILATION_CACHE", os.path.expanduser("~/.cache/janus_tpu_xla")
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -1,0 +1,5 @@
+"""Common runtime utilities (the analog of the reference's janus_core).
+
+HPKE seal/open, clocks, auth tokens, retry policies — everything the
+protocol layers share (reference core/src/*, SURVEY.md §2.3).
+"""

@@ -1,0 +1,89 @@
+"""Authentication tokens (reference core/src/auth_tokens.rs:25,315).
+
+Two token types, matching the reference:
+- Bearer: sent as ``Authorization: Bearer <token>``.
+- DapAuth: sent as the ``DAP-Auth-Token`` header (legacy draft scheme).
+
+Comparison against stored tokens goes through AuthenticationTokenHash
+(SHA-256, constant-time compare) so raw tokens need not be retained.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass
+
+DAP_AUTH_HEADER = "DAP-Auth-Token"
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+@dataclass(frozen=True)
+class AuthenticationToken:
+    TYPE_BEARER = "Bearer"
+    TYPE_DAP_AUTH = "DapAuth"
+
+    token_type: str
+    token: str
+
+    def __post_init__(self):
+        if self.token_type not in (self.TYPE_BEARER, self.TYPE_DAP_AUTH):
+            raise ValueError(f"unknown token type {self.token_type}")
+        if self.token_type == self.TYPE_DAP_AUTH:
+            # DAP-Auth tokens must be visible ASCII (they travel in a header)
+            if not all(0x21 <= ord(c) <= 0x7E for c in self.token):
+                raise ValueError("DAP auth token must be printable ASCII")
+
+    @classmethod
+    def bearer(cls, token: str) -> "AuthenticationToken":
+        return cls(cls.TYPE_BEARER, token)
+
+    @classmethod
+    def dap_auth(cls, token: str) -> "AuthenticationToken":
+        return cls(cls.TYPE_DAP_AUTH, token)
+
+    @classmethod
+    def random_bearer(cls) -> "AuthenticationToken":
+        return cls.bearer(_b64url(os.urandom(16)))
+
+    @classmethod
+    def random_dap_auth(cls) -> "AuthenticationToken":
+        return cls.dap_auth(_b64url(os.urandom(16)))
+
+    def request_headers(self) -> dict[str, str]:
+        if self.token_type == self.TYPE_BEARER:
+            return {"Authorization": f"Bearer {self.token}"}
+        return {DAP_AUTH_HEADER: self.token}
+
+
+@dataclass(frozen=True)
+class AuthenticationTokenHash:
+    """SHA-256 hash of a token, compared in constant time
+    (reference auth_tokens.rs:315)."""
+
+    token_type: str
+    digest: bytes
+
+    @classmethod
+    def of(cls, token: AuthenticationToken) -> "AuthenticationTokenHash":
+        return cls(token.token_type, hashlib.sha256(token.token.encode()).digest())
+
+    def matches(self, token: AuthenticationToken) -> bool:
+        return self.token_type == token.token_type and hmac.compare_digest(
+            self.digest, hashlib.sha256(token.token.encode()).digest()
+        )
+
+
+def extract_bearer_token(headers) -> str | None:
+    """Pull a bearer token out of an Authorization header value mapping."""
+    auth = headers.get("Authorization") or headers.get("authorization")
+    if auth is None:
+        return None
+    if not auth.startswith("Bearer "):
+        return None
+    return auth[len("Bearer "):]

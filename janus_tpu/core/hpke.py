@@ -1,0 +1,292 @@
+"""HPKE (RFC 9180) for DAP: seal/open + keypair management.
+
+The analog of the reference's core/src/hpke.rs (which delegates to the
+hpke-dispatch crate): base-mode single-shot seal/open with the DAP
+application-info discipline (label || sender_role || recipient_role,
+hpke.rs:54-80), plus keypair generation and the supported-configuration
+check (hpke.rs:31).
+
+Implemented directly over the `cryptography` primitives: DHKEM(X25519,
+HKDF-SHA256) and DHKEM(P-256, HKDF-SHA256) KEMs; HKDF-SHA256/384/512 KDFs;
+AES-128-GCM / AES-256-GCM / ChaCha20-Poly1305 AEADs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import (
+    AESGCM,
+    ChaCha20Poly1305,
+)
+from cryptography.hazmat.primitives import serialization
+
+from janus_tpu.messages import (
+    HpkeAeadId,
+    HpkeCiphertext,
+    HpkeConfig,
+    HpkeConfigId,
+    HpkeKdfId,
+    HpkeKemId,
+    HpkePublicKey,
+    Role,
+)
+
+
+class HpkeError(Exception):
+    pass
+
+
+class Label:
+    """Message-specific application-info labels (reference hpke.rs:54-67)."""
+
+    INPUT_SHARE = b"dap-09 input share"
+    AGGREGATE_SHARE = b"dap-09 aggregate share"
+
+
+def application_info(label: bytes, sender: Role, recipient: Role) -> bytes:
+    return label + bytes([int(sender), int(recipient)])
+
+
+# ---------------------------------------------------------------------------
+# KDF plumbing (RFC 9180 §4)
+# ---------------------------------------------------------------------------
+
+_HASHES = {
+    HpkeKdfId.HKDF_SHA256.code: hashlib.sha256,
+    HpkeKdfId.HKDF_SHA384.code: hashlib.sha384,
+    HpkeKdfId.HKDF_SHA512.code: hashlib.sha512,
+}
+
+
+def _hkdf_extract(hash_fn, salt: bytes, ikm: bytes) -> bytes:
+    if not salt:
+        salt = bytes(hash_fn().digest_size)
+    return hmac_mod.new(salt, ikm, hash_fn).digest()
+
+
+def _hkdf_expand(hash_fn, prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac_mod.new(prk, t + info + bytes([i]), hash_fn).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def _labeled_extract(hash_fn, suite_id: bytes, salt: bytes, label: bytes,
+                     ikm: bytes) -> bytes:
+    return _hkdf_extract(hash_fn, salt, b"HPKE-v1" + suite_id + label + ikm)
+
+
+def _labeled_expand(hash_fn, suite_id: bytes, prk: bytes, label: bytes,
+                    info: bytes, length: int) -> bytes:
+    return _hkdf_expand(
+        hash_fn, prk,
+        length.to_bytes(2, "big") + b"HPKE-v1" + suite_id + label + info, length
+    )
+
+
+# ---------------------------------------------------------------------------
+# KEMs (RFC 9180 §4.1)
+# ---------------------------------------------------------------------------
+
+
+class _X25519Kem:
+    ID = HpkeKemId.X25519_HKDF_SHA256.code
+    NSECRET = 32
+    _hash = hashlib.sha256
+
+    @classmethod
+    def generate(cls) -> tuple[bytes, bytes]:
+        sk = X25519PrivateKey.generate()
+        return (
+            sk.private_bytes_raw(),
+            sk.public_key().public_bytes_raw(),
+        )
+
+    @classmethod
+    def _dh(cls, sk_bytes: bytes, pk_bytes: bytes) -> bytes:
+        sk = X25519PrivateKey.from_private_bytes(sk_bytes)
+        return sk.exchange(X25519PublicKey.from_public_bytes(pk_bytes))
+
+    @classmethod
+    def _suite_id(cls) -> bytes:
+        return b"KEM" + cls.ID.to_bytes(2, "big")
+
+    @classmethod
+    def _extract_and_expand(cls, dh: bytes, kem_context: bytes) -> bytes:
+        eae_prk = _labeled_extract(cls._hash, cls._suite_id(), b"", b"eae_prk", dh)
+        return _labeled_expand(
+            cls._hash, cls._suite_id(), eae_prk, b"shared_secret", kem_context,
+            cls.NSECRET,
+        )
+
+    @classmethod
+    def encap(cls, pk_r: bytes) -> tuple[bytes, bytes]:
+        sk_e = X25519PrivateKey.generate()
+        enc = sk_e.public_key().public_bytes_raw()
+        dh = sk_e.exchange(X25519PublicKey.from_public_bytes(pk_r))
+        return cls._extract_and_expand(dh, enc + pk_r), enc
+
+    @classmethod
+    def decap(cls, enc: bytes, sk_r: bytes, pk_r: bytes) -> bytes:
+        dh = cls._dh(sk_r, enc)
+        return cls._extract_and_expand(dh, enc + pk_r)
+
+
+class _P256Kem:
+    ID = HpkeKemId.P256_HKDF_SHA256.code
+    NSECRET = 32
+    _hash = hashlib.sha256
+
+    @classmethod
+    def generate(cls) -> tuple[bytes, bytes]:
+        sk = ec.generate_private_key(ec.SECP256R1())
+        sk_bytes = sk.private_numbers().private_value.to_bytes(32, "big")
+        pk_bytes = sk.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+        )
+        return sk_bytes, pk_bytes
+
+    @classmethod
+    def _load_sk(cls, sk_bytes: bytes) -> ec.EllipticCurvePrivateKey:
+        return ec.derive_private_key(int.from_bytes(sk_bytes, "big"), ec.SECP256R1())
+
+    @classmethod
+    def _load_pk(cls, pk_bytes: bytes) -> ec.EllipticCurvePublicKey:
+        return ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256R1(), pk_bytes)
+
+    @classmethod
+    def _suite_id(cls) -> bytes:
+        return b"KEM" + cls.ID.to_bytes(2, "big")
+
+    @classmethod
+    def _extract_and_expand(cls, dh: bytes, kem_context: bytes) -> bytes:
+        eae_prk = _labeled_extract(cls._hash, cls._suite_id(), b"", b"eae_prk", dh)
+        return _labeled_expand(
+            cls._hash, cls._suite_id(), eae_prk, b"shared_secret", kem_context,
+            cls.NSECRET,
+        )
+
+    @classmethod
+    def encap(cls, pk_r: bytes) -> tuple[bytes, bytes]:
+        sk_e = ec.generate_private_key(ec.SECP256R1())
+        enc = sk_e.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+        )
+        dh = sk_e.exchange(ec.ECDH(), cls._load_pk(pk_r))
+        return cls._extract_and_expand(dh, enc + pk_r), enc
+
+    @classmethod
+    def decap(cls, enc: bytes, sk_r: bytes, pk_r: bytes) -> bytes:
+        dh = cls._load_sk(sk_r).exchange(ec.ECDH(), cls._load_pk(enc))
+        return cls._extract_and_expand(dh, enc + pk_r)
+
+
+_KEMS = {_X25519Kem.ID: _X25519Kem, _P256Kem.ID: _P256Kem}
+
+_AEADS = {
+    HpkeAeadId.AES_128_GCM.code: (AESGCM, 16, 12),
+    HpkeAeadId.AES_256_GCM.code: (AESGCM, 32, 12),
+    HpkeAeadId.CHACHA20_POLY1305.code: (ChaCha20Poly1305, 32, 12),
+}
+
+
+def is_hpke_config_supported(config: HpkeConfig) -> bool:
+    """Mirrors reference hpke.rs:31 (unknown algorithms are unsupported)."""
+    return (config.kem_id.code in _KEMS and config.kdf_id.code in _HASHES
+            and config.aead_id.code in _AEADS)
+
+
+# ---------------------------------------------------------------------------
+# key schedule + single-shot seal/open (RFC 9180 §5-6, base mode)
+# ---------------------------------------------------------------------------
+
+
+def _key_and_nonce(config: HpkeConfig, shared_secret: bytes, info: bytes):
+    hash_fn = _HASHES[config.kdf_id.code]
+    suite_id = (b"HPKE" + config.kem_id.code.to_bytes(2, "big")
+                + config.kdf_id.code.to_bytes(2, "big")
+                + config.aead_id.code.to_bytes(2, "big"))
+    aead_cls, nk, nn = _AEADS[config.aead_id.code]
+    psk_id_hash = _labeled_extract(hash_fn, suite_id, b"", b"psk_id_hash", b"")
+    info_hash = _labeled_extract(hash_fn, suite_id, b"", b"info_hash", info)
+    context = b"\x00" + psk_id_hash + info_hash  # mode_base
+    secret = _labeled_extract(hash_fn, suite_id, shared_secret, b"secret", b"")
+    key = _labeled_expand(hash_fn, suite_id, secret, b"key", context, nk)
+    base_nonce = _labeled_expand(hash_fn, suite_id, secret, b"base_nonce", context, nn)
+    return aead_cls(key), base_nonce
+
+
+def seal(config: HpkeConfig, application_info: bytes, plaintext: bytes,
+         aad: bytes) -> HpkeCiphertext:
+    """Single-shot base-mode seal to the config's public key
+    (reference hpke.rs:167)."""
+    if not is_hpke_config_supported(config):
+        raise HpkeError("unsupported HPKE configuration")
+    kem = _KEMS[config.kem_id.code]
+    shared_secret, enc = kem.encap(config.public_key.data)
+    aead, base_nonce = _key_and_nonce(config, shared_secret, application_info)
+    ct = aead.encrypt(base_nonce, plaintext, aad)  # seq 0 nonce == base nonce
+    return HpkeCiphertext(config.id, enc, ct)
+
+
+def open_ciphertext(keypair: "HpkeKeypair", application_info: bytes,
+                    ciphertext: HpkeCiphertext, aad: bytes) -> bytes:
+    """Single-shot base-mode open (reference hpke.rs:192)."""
+    config = keypair.config
+    if not is_hpke_config_supported(config):
+        raise HpkeError("unsupported HPKE configuration")
+    kem = _KEMS[config.kem_id.code]
+    try:
+        shared_secret = kem.decap(
+            ciphertext.encapsulated_key, keypair.private_key, config.public_key.data
+        )
+        aead, base_nonce = _key_and_nonce(config, shared_secret, application_info)
+        return aead.decrypt(base_nonce, ciphertext.payload, aad)
+    except HpkeError:
+        raise
+    except Exception as e:
+        raise HpkeError("HPKE open failed") from e
+
+
+@dataclass(frozen=True)
+class HpkeKeypair:
+    """An HPKE config plus its private key (reference hpke.rs:240)."""
+
+    config: HpkeConfig
+    private_key: bytes
+
+    @classmethod
+    def generate(
+        cls,
+        config_id: HpkeConfigId | int = 0,
+        kem_id: HpkeKemId = HpkeKemId.X25519_HKDF_SHA256,
+        kdf_id: HpkeKdfId = HpkeKdfId.HKDF_SHA256,
+        aead_id: HpkeAeadId = HpkeAeadId.AES_128_GCM,
+    ) -> "HpkeKeypair":
+        if isinstance(config_id, int):
+            config_id = HpkeConfigId(config_id)
+        kem = _KEMS.get(kem_id.code)
+        if kem is None:
+            raise HpkeError("unsupported KEM")
+        sk, pk = kem.generate()
+        return cls(
+            HpkeConfig(config_id, kem_id, kdf_id, aead_id, HpkePublicKey(pk)), sk
+        )
+
+
+def generate_hpke_config_and_private_key(*args, **kwargs) -> HpkeKeypair:
+    """Name-parity alias for the reference's hpke.rs:212."""
+    return HpkeKeypair.generate(*args, **kwargs)

@@ -1,0 +1,86 @@
+"""HTTP retry policy: capped exponential backoff with jitter
+(reference core/src/retries.rs:33,205).
+
+`retry_http_request(fn)` retries transport errors and retryable HTTP statuses
+(408, 429, 5xx) until the backoff budget is exhausted.  Tests use
+`LimitedRetryer` to bound wall time.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+
+
+def is_retryable_http_status(status: int) -> bool:
+    return status in (408, 429) or 500 <= status <= 599
+
+
+@dataclass
+class Backoff:
+    initial_interval: float = 0.1
+    max_interval: float = 10.0
+    multiplier: float = 2.0
+    max_elapsed_time: float | None = 60.0
+    jitter: float = 0.5  # +/- fraction
+
+    def intervals(self):
+        elapsed = 0.0
+        interval = self.initial_interval
+        while self.max_elapsed_time is None or elapsed < self.max_elapsed_time:
+            jittered = interval * (1 + self.jitter * (2 * random.random() - 1))
+            yield jittered
+            elapsed += jittered
+            interval = min(interval * self.multiplier, self.max_interval)
+
+
+def test_backoff() -> Backoff:  # pragma: no cover - helper for tests
+    return Backoff(initial_interval=0.001, max_interval=0.01, max_elapsed_time=0.1)
+
+
+class LimitedRetryer:
+    """Retry at most `max_retries` times with no waiting (reference retries.rs:230)."""
+
+    def __init__(self, max_retries: int):
+        self.max_retries = max_retries
+
+    def intervals(self):
+        for _ in range(self.max_retries):
+            yield 0.0
+
+
+@dataclass
+class HttpResult:
+    status: int
+    headers: dict
+    body: bytes
+
+
+def retry_http_request(request_fn, backoff: Backoff | LimitedRetryer | None = None,
+                       sleep=time.sleep):
+    """Run request_fn() -> HttpResult, retrying retryable failures.
+
+    request_fn may raise OSError (connection failure) or return an HttpResult
+    with a retryable status.  Returns the final HttpResult, or re-raises the
+    final exception.
+    """
+    backoff = backoff if backoff is not None else Backoff()
+    last_exc = None
+    last_result = None
+    for interval in backoff.intervals():
+        try:
+            result = request_fn()
+            if not is_retryable_http_status(result.status):
+                return result
+            last_result, last_exc = result, None
+        except OSError as e:
+            last_exc, last_result = e, None
+        sleep(interval)
+    # budget exhausted: one final attempt result/error
+    if last_result is not None:
+        return last_result
+    if last_exc is not None:
+        raise last_exc
+    # zero-iteration backoff: run once without retry
+    return request_fn()

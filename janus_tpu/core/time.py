@@ -1,0 +1,40 @@
+"""Clocks: real and mock (reference core/src/time.rs:11,19,42).
+
+MockClock is settable/advanceable and used pervasively in tests so that GC,
+expiry, and lease logic can be driven deterministically instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+from janus_tpu.messages import Duration, Time
+
+
+class Clock:
+    def now(self) -> Time:
+        raise NotImplementedError
+
+
+class RealClock(Clock):
+    def now(self) -> Time:
+        return Time(int(_time.time()))
+
+
+class MockClock(Clock):
+    def __init__(self, start: Time = Time(946_684_800)):  # 2000-01-01T00:00:00Z
+        self._now = start
+        self._lock = threading.Lock()
+
+    def now(self) -> Time:
+        with self._lock:
+            return self._now
+
+    def set(self, t: Time) -> None:
+        with self._lock:
+            self._now = t
+
+    def advance(self, d: Duration) -> None:
+        with self._lock:
+            self._now = self._now.add(d)

@@ -1,0 +1,12 @@
+"""TPU batch VDAF engine — the `vdaf_backend = tpu` dispatch seam.
+
+Where the reference runs one prio prepare call per report inside a sequential
+loop (aggregator.rs:1763, aggregation_job_driver.rs:301 — SURVEY.md §3.2/§3.3),
+this package runs the same math as jitted JAX programs over whole report
+batches, with per-lane failure flags so DAP's per-report error semantics are
+preserved (SURVEY.md §7 hard part 3).
+"""
+
+from janus_tpu.engine.batch import BatchPrio3, PreparedReport
+
+__all__ = ["BatchPrio3", "PreparedReport"]

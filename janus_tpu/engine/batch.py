@@ -1,0 +1,537 @@
+"""Batched Prio3 preparation on device — helper and leader hot loops.
+
+The per-report work of SURVEY.md §3.2 (helper aggregate-init) and §3.3
+(leader init) recast as one jitted program over [N] reports:
+
+    XOF share expansion -> joint randomness derivation -> FLP query ->
+    (helper only) prep-share combination + decide -> output-share truncation
+
+Numerical contract: outputs are bit-identical to janus_tpu.vdaf.prio3 /
+ping_pong for every report whose `fallback` flag is clear.  The flag covers
+the two measure-zero events the device path cannot reproduce exactly —
+XOF rejection-sampling retries (~2^-32/element) and query randomness landing
+in the NTT evaluation domain — and flagged reports are transparently
+recomputed with the host oracle.  Per-report proof failures are NOT
+fallbacks: they surface as `status="failed"` lanes, matching the reference's
+per-report PrepareError semantics (aggregator.rs:1969-1993).
+
+Only the standard TurboShake128 XOF runs on device; the HmacSha256Aes128
+multiproof variant (core/src/vdaf.rs:24) currently takes the host path for
+XOFs and the device path is disabled for it (engine falls back per batch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.ops import xof_batch
+from janus_tpu.ops.flp_batch import BatchFlp, field_ops
+from janus_tpu.vdaf import ping_pong
+from janus_tpu.vdaf.field_ref import Field64
+from janus_tpu.vdaf.prio3 import (
+    USAGE_JOINT_RAND_PART,
+    USAGE_JOINT_RAND_SEED,
+    USAGE_JOINT_RANDOMNESS,
+    USAGE_MEAS_SHARE,
+    USAGE_PROOF_SHARE,
+    USAGE_QUERY_RANDOMNESS,
+    PrepState,
+    Prio3,
+    VdafError,
+)
+from janus_tpu.vdaf.xof import XofTurboShake128
+
+
+@dataclass
+class PreparedReport:
+    """Per-report outcome of a batched prepare step."""
+
+    status: str  # "finished" | "continued" | "failed"
+    error: str | None = None
+    outbound: ping_pong.PingPongMessage | None = None
+    out_share_raw: np.ndarray | None = None  # [OUTPUT_LEN, L] uint32, raw form
+    prep_share: bytes | None = None
+    state: object | None = None  # leader: PingPongContinued
+
+
+def _bytes_rows(rows: list[bytes], width: int) -> np.ndarray:
+    return np.frombuffer(b"".join(rows), dtype=np.uint8).reshape(len(rows), width)
+
+
+def bucket_size(n: int) -> int:
+    """Pad a batch size to a bucket to bound the number of compiled
+    executables (SURVEY.md §7 hard part 4): powers of two and their 1.5x
+    midpoints, minimum 8."""
+    if n <= 8:
+        return 8
+    p = 8
+    while p < n:
+        if p * 3 // 2 >= n:
+            return p * 3 // 2
+        p *= 2
+    return p
+
+
+class BatchPrio3:
+    """Batched preparation engine for one Prio3 instance.
+
+    One instance per (VDAF config); jitted executables are cached per batch
+    size, so callers should bucket/pad batch sizes upstream (the aggregator's
+    job sizing takes care of this — SURVEY.md §7 hard part 4).
+    """
+
+    def __init__(self, vdaf: Prio3):
+        self.vdaf = vdaf
+        self.flp = vdaf.flp
+        self.field = vdaf.field
+        self.f = field_ops(self.field)
+        self.bflp = BatchFlp(vdaf.flp)
+        self.L = self.f.LIMBS
+        self.P = vdaf.proofs
+        self.has_jr = vdaf.has_joint_rand
+        # Only the TurboShake128 XOF has a device implementation.
+        self.device_ok = vdaf.xof is XofTurboShake128
+        self._expand = (
+            xof_batch.expand_field64 if self.field is Field64 else xof_batch.expand_field128
+        )
+        self._helper_fns: dict[int, object] = {}
+        self._leader_fns: dict[int, object] = {}
+        self.fallback_count = 0  # reports recomputed on host (observability)
+
+    # -- host-side decoding helpers --------------------------------------
+
+    def _decode_field_vec(self, data: bytes, n: int) -> tuple[np.ndarray, bool]:
+        """bytes -> ([n, L] uint32 raw limbs, in_range).  No exceptions."""
+        want = n * self.field.ENCODED_SIZE
+        if len(data) != want:
+            raise VdafError("bad field vector length")
+        limbs = np.frombuffer(data, dtype="<u4").reshape(n, self.L)
+        if self.field is Field64:
+            vals = np.frombuffer(data, dtype="<u8")
+            ok = bool((vals < np.uint64(self.field.MODULUS)).all())
+        else:
+            p_limbs = [(self.field.MODULUS >> (32 * i)) & 0xFFFFFFFF for i in range(4)]
+            gt = np.zeros(n, dtype=bool)
+            eq = np.ones(n, dtype=bool)
+            for i in range(3, -1, -1):
+                c = np.uint32(p_limbs[i])
+                gt |= eq & (limbs[:, i] > c)
+                eq &= limbs[:, i] == c
+            ok = not bool((gt | eq).any())
+        return limbs, ok
+
+    def _split_prep_share(self, data: bytes) -> tuple[bytes, bytes]:
+        """encoded prep share -> (joint rand part, verifier bytes)."""
+        ss = self.vdaf.SEED_SIZE if self.has_jr else 0
+        vlen = self.P * self.flp.VERIFIER_LEN * self.field.ENCODED_SIZE
+        if len(data) != ss + vlen:
+            raise VdafError("bad prep share length")
+        return data[:ss], data[ss:]
+
+    # -- device kernels ---------------------------------------------------
+
+    def _dst(self, usage: int) -> bytes:
+        return self.vdaf.dst(usage)
+
+    def _kernel_common(self, bs, meas_raw, proofs_raw, nonces, vk, parts_static):
+        """Shared tail: joint/query randomness + FLP query.
+
+        parts_static: the peer's joint-rand part [N, 16] from the public
+        share, in aggregator order around `own_part`.
+        Returns (verifier_internal [N, P, VLEN, L], state_seed [N,16] u8 or
+        None, reject [N], bad_t [N], meas_internal).
+        """
+        f = self.f
+        N = bs[0]
+        P = self.P
+        reject = jnp.zeros(bs, dtype=bool)
+        if self.has_jr:
+            state_seed_parts = parts_static  # list of u8 arrays in order
+            state_seed = xof_batch.derive_seed(
+                bs,
+                [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_SEED),
+                                      bytes(self.vdaf.SEED_SIZE))] + state_seed_parts,
+            )
+            jr_raw, rej = self._expand(
+                bs,
+                [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RANDOMNESS)), state_seed],
+                P * self.flp.JOINT_RAND_LEN,
+            )
+            reject = reject | rej
+            jr = f.from_raw(jr_raw).reshape(bs + (P, self.flp.JOINT_RAND_LEN, self.L))
+        else:
+            state_seed = None
+            jr = f.zeros(bs + (P, 0))
+        qr_raw, rej = self._expand(
+            bs,
+            [xof_batch.xof_prefix(self._dst(USAGE_QUERY_RANDOMNESS)),
+             jnp.broadcast_to(vk, bs + (self.vdaf.VERIFY_KEY_SIZE,)), nonces],
+            P * self.flp.QUERY_RAND_LEN,
+        )
+        reject = reject | rej
+        qr = f.from_raw(qr_raw).reshape(bs + (P, self.flp.QUERY_RAND_LEN, self.L))
+
+        meas = f.from_raw(meas_raw)
+        proofs = f.from_raw(proofs_raw).reshape(bs + (P, self.flp.PROOF_LEN, self.L))
+        meas_b = jnp.broadcast_to(
+            meas[:, None], bs + (P, self.flp.MEAS_LEN, self.L)
+        )
+        verifier, bad_t = self.bflp.query(meas_b, proofs, qr, jr, self.vdaf.shares)
+        bad_t = jnp.any(bad_t, axis=-1)
+        return verifier, state_seed, reject, bad_t, meas
+
+    def _helper_fn(self, N: int):
+        if N in self._helper_fns:
+            return self._helper_fns[N]
+        f = self.f
+        P = self.P
+        vlen = self.flp.VERIFIER_LEN
+
+        def kernel(vk, seeds, blinds, nonces, pub0, leader_jr_parts, leader_verifs_raw):
+            bs = (N,)
+            meas_raw, rej1 = self._expand(
+                bs,
+                [xof_batch.xof_prefix(self._dst(USAGE_MEAS_SHARE)), seeds, b"\x01"],
+                self.flp.MEAS_LEN,
+            )
+            proofs_raw, rej2 = self._expand(
+                bs,
+                [xof_batch.xof_prefix(self._dst(USAGE_PROOF_SHARE)), seeds, b"\x01"],
+                P * self.flp.PROOF_LEN,
+            )
+            reject = rej1 | rej2
+            if self.has_jr:
+                meas_bytes = xof_batch.vec_limbs_to_bytes(meas_raw)
+                own_part = xof_batch.derive_seed(
+                    bs,
+                    [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_PART)), blinds,
+                     b"\x01", nonces, meas_bytes],
+                )
+                parts = [pub0, own_part]
+            else:
+                own_part = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+                parts = []
+            verifier, state_seed, rej3, bad_t, meas = self._kernel_common(
+                bs, meas_raw, proofs_raw, nonces, vk, parts
+            )
+            reject = reject | rej3
+            # prep_shares_to_prep: combine, decide, message seed from claimed parts
+            lv = f.from_raw(leader_verifs_raw).reshape(bs + (P, vlen, self.L))
+            total = f.add(verifier, lv)
+            proof_ok = jnp.all(self.bflp.decide(total), axis=-1)
+            if self.has_jr:
+                msg_seed = xof_batch.derive_seed(
+                    bs,
+                    [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_SEED),
+                                          bytes(self.vdaf.SEED_SIZE)),
+                     leader_jr_parts, own_part],
+                )
+                jr_ok = jnp.all(msg_seed == state_seed, axis=-1)
+            else:
+                msg_seed = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+                jr_ok = jnp.ones(bs, dtype=bool)
+            out_share = f.to_raw(self.bflp.truncate(meas))
+            verif_raw = f.to_raw(verifier).reshape(bs + (P * vlen, self.L))
+            return (verif_raw, own_part, msg_seed, out_share, proof_ok, jr_ok,
+                    reject | bad_t)
+
+        fn = jax.jit(kernel)
+        self._helper_fns[N] = fn
+        return fn
+
+    def _leader_fn(self, N: int):
+        if N in self._leader_fns:
+            return self._leader_fns[N]
+        f = self.f
+        P = self.P
+        vlen = self.flp.VERIFIER_LEN
+
+        def kernel(vk, meas_raw, proofs_raw, blinds, nonces, pub1):
+            bs = (N,)
+            if self.has_jr:
+                meas_bytes = xof_batch.vec_limbs_to_bytes(meas_raw)
+                own_part = xof_batch.derive_seed(
+                    bs,
+                    [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_PART)), blinds,
+                     b"\x00", nonces, meas_bytes],
+                )
+                parts = [own_part, pub1]
+            else:
+                own_part = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+                parts = []
+            verifier, state_seed, reject, bad_t, meas = self._kernel_common(
+                bs, meas_raw, proofs_raw, nonces, vk, parts
+            )
+            out_share = f.to_raw(self.bflp.truncate(meas))
+            verif_raw = f.to_raw(verifier).reshape(bs + (P * vlen, self.L))
+            if state_seed is None:
+                state_seed = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+            return verif_raw, own_part, state_seed, out_share, reject | bad_t
+
+        fn = jax.jit(kernel)
+        self._leader_fns[N] = fn
+        return fn
+
+    # -- public batched API ----------------------------------------------
+
+    def helper_init_batch(
+        self,
+        verify_key: bytes,
+        nonces: list[bytes],
+        public_shares: list[bytes],
+        input_shares: list[bytes],
+        inbound_messages: list[ping_pong.PingPongMessage],
+    ) -> list[PreparedReport]:
+        """Batched ping_pong.helper_initialized + transition.evaluate().
+
+        Returns one PreparedReport per input, in order: status "finished"
+        with the outbound finish message and raw output share, or "failed"
+        with the reason (bad proof / joint rand mismatch / decode error).
+        """
+        N = len(nonces)
+        assert N == len(public_shares) == len(input_shares) == len(inbound_messages)
+        if not self.device_ok:
+            return [
+                self._host_helper(verify_key, nonces[i], public_shares[i],
+                                  input_shares[i], inbound_messages[i])
+                for i in range(N)
+            ]
+
+        M = bucket_size(N)
+        seeds = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        blinds = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        pub0 = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        ljr = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        lverif = np.zeros((M, self.P * self.flp.VERIFIER_LEN, self.L), dtype=np.uint32)
+        decode_err: dict[int, str] = {}
+        for i in range(N):
+            try:
+                seed, blind = self.vdaf.decode_input_share(1, input_shares[i])
+                pub = self.vdaf.decode_public_share(public_shares[i])
+                msg = inbound_messages[i]
+                if msg.type != ping_pong.PingPongMessage.TYPE_INITIALIZE:
+                    raise VdafError("expected initialize message")
+                part, verif_bytes = self._split_prep_share(msg.prep_share)
+                limbs, in_range = self._decode_field_vec(
+                    verif_bytes, self.P * self.flp.VERIFIER_LEN
+                )
+                if not in_range:
+                    raise VdafError("prep share element out of range")
+                seeds[i] = np.frombuffer(seed, dtype=np.uint8)
+                if self.has_jr:
+                    blinds[i] = np.frombuffer(blind, dtype=np.uint8)
+                    pub0[i] = np.frombuffer(pub[0], dtype=np.uint8)
+                    ljr[i] = np.frombuffer(part, dtype=np.uint8)
+                lverif[i] = limbs
+            except (VdafError, ValueError, AssertionError) as e:
+                decode_err[i] = str(e)
+
+        vk = np.frombuffer(verify_key, dtype=np.uint8)
+        fn = self._helper_fn(M)
+        nonce_rows = np.zeros((M, 16), dtype=np.uint8)
+        nonce_rows[:N] = nonces_arr(nonces)
+        verif_raw, own_part, msg_seed, out_share, proof_ok, jr_ok, fallback = (
+            np.asarray(x) for x in fn(vk, seeds, blinds, nonce_rows, pub0,
+                                      ljr, lverif)
+        )
+
+        out: list[PreparedReport] = []
+        for i in range(N):
+            if i in decode_err:
+                out.append(PreparedReport("failed", error=decode_err[i]))
+                continue
+            if fallback[i]:
+                self.fallback_count += 1
+                out.append(self._host_helper(verify_key, nonces[i], public_shares[i],
+                                             input_shares[i], inbound_messages[i]))
+                continue
+            if not (proof_ok[i] and jr_ok[i]):
+                reason = "proof verification failed" if not proof_ok[i] else (
+                    "joint randomness check failed")
+                out.append(PreparedReport("failed", error=reason))
+                continue
+            prep_msg = bytes(msg_seed[i]) if self.has_jr else b""
+            outbound = ping_pong.PingPongMessage(
+                ping_pong.PingPongMessage.TYPE_FINISH, prep_msg=prep_msg
+            )
+            prep_share = (bytes(own_part[i]) if self.has_jr else b"") + (
+                verif_raw[i].astype("<u4").tobytes()
+            )
+            out.append(PreparedReport(
+                "finished", outbound=outbound, out_share_raw=out_share[i],
+                prep_share=prep_share,
+            ))
+        return out
+
+    def leader_init_batch(
+        self,
+        verify_key: bytes,
+        nonces: list[bytes],
+        public_shares: list[bytes],
+        input_shares: list[bytes],
+    ) -> list[PreparedReport]:
+        """Batched ping_pong.leader_initialized.
+
+        Returns reports with status "continued": `state` holds the
+        PingPongContinued (with device-computed prep state), `outbound` the
+        initialize message carrying the leader's prep share.
+        """
+        N = len(nonces)
+        if not self.device_ok:
+            return [
+                self._host_leader(verify_key, nonces[i], public_shares[i], input_shares[i])
+                for i in range(N)
+            ]
+        M = bucket_size(N)
+        meas_raw = np.zeros((M, self.flp.MEAS_LEN, self.L), dtype=np.uint32)
+        proofs_raw = np.zeros((M, self.P * self.flp.PROOF_LEN, self.L), dtype=np.uint32)
+        blinds = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        pub1 = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        decode_err: dict[int, str] = {}
+        for i in range(N):
+            try:
+                # slice the leader input share without round-tripping ints:
+                # layout is meas || proofs || blind (prio3.encode_input_share)
+                es = self.field.ENCODED_SIZE
+                n_meas = self.flp.MEAS_LEN * es
+                n_proof = self.P * self.flp.PROOF_LEN * es
+                want = n_meas + n_proof + (self.vdaf.SEED_SIZE if self.has_jr else 0)
+                if len(input_shares[i]) != want:
+                    raise VdafError("bad leader input share length")
+                pub = self.vdaf.decode_public_share(public_shares[i])
+                mlimbs, ok1 = self._decode_field_vec(
+                    input_shares[i][:n_meas], self.flp.MEAS_LEN
+                )
+                plimbs, ok2 = self._decode_field_vec(
+                    input_shares[i][n_meas : n_meas + n_proof],
+                    self.P * self.flp.PROOF_LEN,
+                )
+                if not (ok1 and ok2):
+                    raise VdafError("input share element out of range")
+                meas_raw[i] = mlimbs
+                proofs_raw[i] = plimbs
+                if self.has_jr:
+                    blinds[i] = np.frombuffer(
+                        input_shares[i][n_meas + n_proof :], dtype=np.uint8
+                    )
+                    pub1[i] = np.frombuffer(pub[1], dtype=np.uint8)
+            except (VdafError, ValueError, AssertionError) as e:
+                decode_err[i] = str(e)
+
+        vk = np.frombuffer(verify_key, dtype=np.uint8)
+        fn = self._leader_fn(M)
+        nonce_rows = np.zeros((M, 16), dtype=np.uint8)
+        nonce_rows[:N] = nonces_arr(nonces)
+        verif_raw, own_part, state_seed, out_share, fallback = (
+            np.asarray(x)
+            for x in fn(vk, meas_raw, proofs_raw, blinds, nonce_rows, pub1)
+        )
+        out: list[PreparedReport] = []
+        for i in range(N):
+            if i in decode_err:
+                out.append(PreparedReport("failed", error=decode_err[i]))
+                continue
+            if fallback[i]:
+                self.fallback_count += 1
+                out.append(self._host_leader(verify_key, nonces[i], public_shares[i],
+                                             input_shares[i]))
+                continue
+            prep_share = (bytes(own_part[i]) if self.has_jr else b"") + (
+                verif_raw[i].astype("<u4").tobytes()
+            )
+            jr_seed = bytes(state_seed[i]) if self.has_jr else None
+            state = ping_pong.PingPongContinued(
+                PrepState(self._raw_to_ints(out_share[i]), jr_seed), 0
+            )
+            outbound = ping_pong.PingPongMessage(
+                ping_pong.PingPongMessage.TYPE_INITIALIZE, prep_share=prep_share
+            )
+            out.append(PreparedReport(
+                "continued", outbound=outbound, out_share_raw=out_share[i],
+                prep_share=prep_share, state=state,
+            ))
+        return out
+
+    # -- host fallbacks ----------------------------------------------------
+
+    def _host_helper(self, verify_key, nonce, public_share, input_share, inbound):
+        try:
+            pub = self.vdaf.decode_public_share(public_share)
+            ishare = self.vdaf.decode_input_share(1, input_share)
+            transition = ping_pong.helper_initialized(
+                self.vdaf, verify_key, nonce, pub, ishare, inbound
+            )
+            state, outbound = transition.evaluate()
+            return PreparedReport(
+                "finished", outbound=outbound,
+                out_share_raw=self._ints_to_raw(state.out_share),
+            )
+        except (VdafError, ValueError, AssertionError, NotImplementedError) as e:
+            return PreparedReport("failed", error=str(e))
+
+    def _host_leader(self, verify_key, nonce, public_share, input_share):
+        try:
+            pub = self.vdaf.decode_public_share(public_share)
+            ishare = self.vdaf.decode_input_share(0, input_share)
+            state, outbound = ping_pong.leader_initialized(
+                self.vdaf, verify_key, nonce, pub, ishare
+            )
+            return PreparedReport(
+                "continued", outbound=outbound, state=state,
+                out_share_raw=self._ints_to_raw(state.prep_state.out_share),
+                prep_share=outbound.prep_share,
+            )
+        except (VdafError, ValueError, AssertionError, NotImplementedError) as e:
+            return PreparedReport("failed", error=str(e))
+
+    # -- finishing / aggregation ------------------------------------------
+
+    def leader_finish(
+        self, reports: list[PreparedReport],
+        inbound_messages: list[ping_pong.PingPongMessage],
+    ) -> list[PreparedReport]:
+        """Batched ping_pong.leader_continued: cheap host-side seed compare."""
+        out = []
+        for rep, msg in zip(reports, inbound_messages):
+            if rep.status != "continued":
+                out.append(rep)
+                continue
+            try:
+                finished = ping_pong.leader_continued(self.vdaf, rep.state, msg)
+                out.append(PreparedReport(
+                    "finished", out_share_raw=self._ints_to_raw(finished.out_share)
+                ))
+            except (VdafError, NotImplementedError) as e:
+                out.append(PreparedReport("failed", error=str(e)))
+        return out
+
+    def aggregate(self, reports: list[PreparedReport]) -> list[int]:
+        """Sum the output shares of all finished reports (host tree-sum)."""
+        agg = self.vdaf.aggregate_init()
+        for rep in reports:
+            if rep.status == "finished" and rep.out_share_raw is not None:
+                agg = self.vdaf.aggregate_update(agg, self._raw_to_ints(rep.out_share_raw))
+        return agg
+
+    # -- limb conversion helpers ------------------------------------------
+
+    def _raw_to_ints(self, raw: np.ndarray) -> list[int]:
+        out = []
+        for row in np.asarray(raw, dtype=np.uint32):
+            out.append(sum(int(row[k]) << (32 * k) for k in range(self.L)))
+        return out
+
+    def _ints_to_raw(self, vals: list[int]) -> np.ndarray:
+        arr = np.zeros((len(vals), self.L), dtype=np.uint32)
+        for i, v in enumerate(vals):
+            for k in range(self.L):
+                arr[i, k] = (v >> (32 * k)) & 0xFFFFFFFF
+        return arr
+
+
+def nonces_arr(nonces: list[bytes]) -> np.ndarray:
+    return _bytes_rows(nonces, 16)

@@ -1,0 +1,90 @@
+"""Host-oracle prepare engine: per-report ping-pong on CPU.
+
+Same interface as BatchPrio3 but loops the oracle — used for test VDAFs
+(Fake*) and any instance without a device path.  This mirrors the
+reference's behavior, where every VDAF goes through the same vdaf_dispatch!
+surface regardless of backing implementation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from janus_tpu.engine.batch import PreparedReport
+from janus_tpu.vdaf import ping_pong
+from janus_tpu.vdaf.prio3 import VdafError
+
+
+class HostPrepEngine:
+    def __init__(self, vdaf):
+        self.vdaf = vdaf
+        self.fallback_count = 0
+
+    def _out_share_arr(self, out_share) -> np.ndarray:
+        return np.asarray([[v & 0xFFFFFFFF, v >> 32] for v in out_share],
+                          dtype=np.uint64).astype(np.uint32)
+
+    def _raw_to_ints(self, raw) -> list[int]:
+        return [int(row[0]) | int(row[1]) << 32 for row in np.asarray(raw)]
+
+    def helper_init_batch(self, verify_key, nonces, public_shares, input_shares,
+                          inbound_messages) -> list[PreparedReport]:
+        out = []
+        for nonce, pub_bytes, in_bytes, inbound in zip(
+            nonces, public_shares, input_shares, inbound_messages
+        ):
+            try:
+                pub = self.vdaf.decode_public_share(pub_bytes)
+                share = self.vdaf.decode_input_share(1, in_bytes)
+                transition = ping_pong.helper_initialized(
+                    self.vdaf, verify_key, nonce, pub, share, inbound
+                )
+                state, outbound = transition.evaluate()
+                out.append(PreparedReport(
+                    "finished", outbound=outbound,
+                    out_share_raw=self._out_share_arr(state.out_share),
+                ))
+            except (VdafError, ValueError, AssertionError, NotImplementedError) as e:
+                out.append(PreparedReport("failed", error=str(e)))
+        return out
+
+    def leader_init_batch(self, verify_key, nonces, public_shares,
+                          input_shares) -> list[PreparedReport]:
+        out = []
+        for nonce, pub_bytes, in_bytes in zip(nonces, public_shares, input_shares):
+            try:
+                pub = self.vdaf.decode_public_share(pub_bytes)
+                share = self.vdaf.decode_input_share(0, in_bytes)
+                state, outbound = ping_pong.leader_initialized(
+                    self.vdaf, verify_key, nonce, pub, share
+                )
+                out.append(PreparedReport(
+                    "continued", outbound=outbound, state=state,
+                    out_share_raw=self._out_share_arr(state.prep_state.out_share),
+                    prep_share=outbound.prep_share,
+                ))
+            except (VdafError, ValueError, AssertionError, NotImplementedError) as e:
+                out.append(PreparedReport("failed", error=str(e)))
+        return out
+
+    def leader_finish(self, reports, inbound_messages) -> list[PreparedReport]:
+        out = []
+        for rep, msg in zip(reports, inbound_messages):
+            if rep.status != "continued":
+                out.append(rep)
+                continue
+            try:
+                finished = ping_pong.leader_continued(self.vdaf, rep.state, msg)
+                out.append(PreparedReport(
+                    "finished", out_share_raw=self._out_share_arr(finished.out_share)
+                ))
+            except (VdafError, NotImplementedError) as e:
+                out.append(PreparedReport("failed", error=str(e)))
+        return out
+
+    def aggregate(self, reports) -> list:
+        agg = self.vdaf.aggregate_init()
+        for rep in reports:
+            if rep.status == "finished" and rep.out_share_raw is not None:
+                agg = self.vdaf.aggregate_update(agg, self._raw_to_ints(rep.out_share_raw))
+        return agg

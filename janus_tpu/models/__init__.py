@@ -1,0 +1,16 @@
+"""VDAF instance registry + dispatch (reference core/src/vdaf.rs:65,517).
+
+`VdafInstance` is the declarative description of a task's VDAF that lives in
+task configs and the datastore; `dispatch()` turns it into a concrete oracle
+VDAF plus a prepare engine (the TPU batch engine where available, host oracle
+otherwise) — the seam the reference implements with the vdaf_dispatch! macro.
+"""
+
+from janus_tpu.models.vdaf_instance import (
+    VdafInstance,
+    dispatch,
+    prep_engine,
+    vdaf_for_instance,
+)
+
+__all__ = ["VdafInstance", "dispatch", "prep_engine", "vdaf_for_instance"]

@@ -1,0 +1,188 @@
+"""VdafInstance: serializable VDAF descriptors + the dispatch seam.
+
+Mirrors the reference's enum (core/src/vdaf.rs:65-108): Prio3Count,
+Prio3Sum{bits}, Prio3SumVec{bits,length,chunk_length},
+Prio3SumVecField64MultiproofHmacSha256Aes128{proofs,bits,length,chunk_length},
+Prio3Histogram{length,chunk_length}, Poplar1{bits} (not yet implemented),
+plus the test-only Fake / FakeFailsPrepInit / FakeFailsPrepStep.
+
+The serde form matches Rust's externally-tagged enum encoding so task configs
+are interchangeable: "Prio3Count" (unit) or {"Prio3Sum": {"bits": 32}}.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from janus_tpu.vdaf import prio3 as _prio3
+from janus_tpu.vdaf.dummy import DummyVdaf
+
+# Verify-key sizes (reference core/src/vdaf.rs:16,24).
+VERIFY_KEY_LENGTH = 16
+VERIFY_KEY_LENGTH_HMACSHA256_AES128 = 32
+
+
+@dataclass(frozen=True)
+class VdafInstance:
+    kind: str
+    params: tuple = ()  # sorted (name, value) pairs
+
+    _PARAM_NAMES = {
+        "Prio3Count": (),
+        "Prio3Sum": ("bits",),
+        "Prio3SumVec": ("bits", "length", "chunk_length"),
+        "Prio3SumVecField64MultiproofHmacSha256Aes128": (
+            "proofs", "bits", "length", "chunk_length"),
+        "Prio3Histogram": ("length", "chunk_length"),
+        "Poplar1": ("bits",),
+        "Fake": ("rounds",),
+        "FakeFailsPrepInit": (),
+        "FakeFailsPrepStep": (),
+    }
+
+    def __post_init__(self):
+        if self.kind not in self._PARAM_NAMES:
+            raise ValueError(f"unknown VDAF kind {self.kind}")
+        want = self._PARAM_NAMES[self.kind]
+        got = tuple(name for name, _ in self.params)
+        if got != want:
+            raise ValueError(f"{self.kind} expects params {want}, got {got}")
+
+    def __getattr__(self, name):
+        for k, v in object.__getattribute__(self, "params"):
+            if k == name:
+                return v
+        raise AttributeError(name)
+
+    # -- constructors -----------------------------------------------------
+
+    @classmethod
+    def prio3_count(cls) -> "VdafInstance":
+        return cls("Prio3Count")
+
+    @classmethod
+    def prio3_sum(cls, bits: int) -> "VdafInstance":
+        return cls("Prio3Sum", (("bits", bits),))
+
+    @classmethod
+    def prio3_sum_vec(cls, bits: int, length: int, chunk_length: int) -> "VdafInstance":
+        return cls("Prio3SumVec",
+                    (("bits", bits), ("length", length), ("chunk_length", chunk_length)))
+
+    @classmethod
+    def prio3_sum_vec_field64_multiproof_hmac_sha256_aes128(
+        cls, proofs: int, bits: int, length: int, chunk_length: int
+    ) -> "VdafInstance":
+        return cls(
+            "Prio3SumVecField64MultiproofHmacSha256Aes128",
+            (("proofs", proofs), ("bits", bits), ("length", length),
+             ("chunk_length", chunk_length)),
+        )
+
+    @classmethod
+    def prio3_histogram(cls, length: int, chunk_length: int) -> "VdafInstance":
+        return cls("Prio3Histogram", (("length", length), ("chunk_length", chunk_length)))
+
+    @classmethod
+    def fake(cls, rounds: int = 1) -> "VdafInstance":
+        return cls("Fake", (("rounds", rounds),))
+
+    @classmethod
+    def fake_fails_prep_init(cls) -> "VdafInstance":
+        return cls("FakeFailsPrepInit")
+
+    @classmethod
+    def fake_fails_prep_step(cls) -> "VdafInstance":
+        return cls("FakeFailsPrepStep")
+
+    # -- properties -------------------------------------------------------
+
+    @property
+    def verify_key_length(self) -> int:
+        if self.kind == "Prio3SumVecField64MultiproofHmacSha256Aes128":
+            return VERIFY_KEY_LENGTH_HMACSHA256_AES128
+        if self.kind.startswith("Fake"):
+            return 0
+        return VERIFY_KEY_LENGTH
+
+    @property
+    def is_test(self) -> bool:
+        return self.kind.startswith("Fake")
+
+    # -- serde (Rust externally-tagged enum form) -------------------------
+
+    def to_json_obj(self):
+        if not self.params:
+            return self.kind
+        return {self.kind: dict(self.params)}
+
+    @classmethod
+    def from_json_obj(cls, obj) -> "VdafInstance":
+        if isinstance(obj, str):
+            return cls(obj)
+        if isinstance(obj, dict) and len(obj) == 1:
+            kind, params = next(iter(obj.items()))
+            want = cls._PARAM_NAMES.get(kind)
+            if want is None:
+                raise ValueError(f"unknown VDAF kind {kind}")
+            if set(params) != set(want):
+                raise ValueError(f"{kind} expects params {want}")
+            return cls(kind, tuple((name, params[name]) for name in want))
+        raise ValueError(f"bad VdafInstance encoding: {obj!r}")
+
+
+def vdaf_for_instance(inst: VdafInstance):
+    """Instantiate the oracle VDAF (the analog of vdaf_dispatch!'s concrete
+    type construction, core/src/vdaf.rs:178-195)."""
+    k = inst.kind
+    if k == "Prio3Count":
+        return _prio3.new_count()
+    if k == "Prio3Sum":
+        return _prio3.new_sum(inst.bits)
+    if k == "Prio3SumVec":
+        return _prio3.new_sum_vec(inst.length, inst.bits, inst.chunk_length)
+    if k == "Prio3SumVecField64MultiproofHmacSha256Aes128":
+        return _prio3.new_sum_vec_field64_multiproof_hmac(
+            inst.length, inst.bits, inst.chunk_length, inst.proofs
+        )
+    if k == "Prio3Histogram":
+        return _prio3.new_histogram(inst.length, inst.chunk_length)
+    if k == "Fake":
+        return DummyVdaf()
+    if k == "FakeFailsPrepInit":
+        return DummyVdaf(fail_prep_init=True)
+    if k == "FakeFailsPrepStep":
+        return DummyVdaf(fail_prep_step=True)
+    raise NotImplementedError(f"VDAF {k} not yet implemented")
+
+
+# Engine cache: one batch engine per instance per process (compiled
+# executables are expensive; reference analog is the per-task Arc<vdaf>).
+_engine_lock = threading.Lock()
+_engines: dict[VdafInstance, object] = {}
+
+
+def prep_engine(inst: VdafInstance):
+    """The prepare engine for an instance: TPU batch engine for Prio3,
+    host-oracle engine for test VDAFs."""
+    with _engine_lock:
+        engine = _engines.get(inst)
+        if engine is None:
+            vdaf = vdaf_for_instance(inst)
+            if isinstance(vdaf, DummyVdaf):
+                from janus_tpu.engine.host import HostPrepEngine
+
+                engine = HostPrepEngine(vdaf)
+            else:
+                from janus_tpu.engine import BatchPrio3
+
+                engine = BatchPrio3(vdaf)
+            _engines[inst] = engine
+        return engine
+
+
+def dispatch(inst: VdafInstance):
+    """-> (oracle vdaf, prep engine)."""
+    engine = prep_engine(inst)
+    return engine.vdaf, engine

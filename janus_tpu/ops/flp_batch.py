@@ -6,15 +6,22 @@ static-shape array programs over a report batch:
 
 - Circuit wire values are built by small per-circuit classes (Count, Sum,
   SumVec, Histogram) as [..., calls, arity, L] limb arrays.
-- Wire polynomials are interpolated with a batched INTT over the p2-subgroup
-  and evaluated at the query point t by Horner (static unroll).
+- Wire polynomials are evaluated at the query point t **barycentrically**:
+  p(t) = ((t^p2 - 1)/p2) * sum_i evals_i * w^i/(t - w^i).  The denominator
+  vector is shared by every wire, so the whole [arity, p2] evaluation is one
+  vectorized multiply + tree reduction instead of per-wire INTT + Horner —
+  this keeps the XLA graph small (compile time) and the arithmetic wide
+  (VPU-friendly), at the cost of p2 field inversions per report (done as a
+  scan-rolled Fermat ladder, fully lane-parallel).
 - The gadget polynomial's values at the call points alpha^(k+1) are obtained
   by folding its coefficients mod (x^p2 - 1) and running a forward NTT —
   O(p2 log p2) instead of m Horner evaluations of a degree-2(p2-1) poly.
+  Its value at t is a lax.scan-rolled Horner (one multiply in the graph).
 - `query` returns a per-report `bad_t` flag where the query randomness lands
-  in the wire-interpolation domain (t^p2 == 1); the oracle raises FlpError
-  there (probability ~p2/p per report) and flagged reports take the host
-  fallback path, preserving bit-exact semantics.
+  in the wire-interpolation domain (t^p2 == 1; there the barycentric
+  denominators vanish); the oracle raises FlpError there (probability ~p2/p
+  per report) and flagged reports take the host fallback path, preserving
+  bit-exact semantics.
 
 All circuits here have exactly one gadget, matching the oracle
 (janus_tpu/vdaf/flp.py) and the VDAF spec's Prio3 instantiations.
@@ -22,7 +29,9 @@ All circuits here have exactly one gadget, matching the oracle
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from janus_tpu.ops import field64 as _f64
 from janus_tpu.ops import field128 as _f128
@@ -43,21 +52,44 @@ def _horner(f, coeffs, x, axis=-2):
     """Evaluate polynomials (coefficient axis `axis`, low order first) at x.
 
     coeffs: [..., n, ..., L]; x broadcastable to the coefficient-slice shape.
+    lax.scan-rolled: one field multiply in the compiled graph.
     """
     c = jnp.moveaxis(coeffs, axis, 0)
     xb = jnp.broadcast_to(x, c.shape[1:])
-    acc = jnp.broadcast_to(c[-1], xb.shape)
-    for i in range(c.shape[0] - 2, -1, -1):
-        acc = f.add(f.mul(acc, xb), c[i])
+
+    def body(acc, ci):
+        return f.add(f.mul(acc, xb), ci), None
+
+    acc, _ = jax.lax.scan(body, jnp.broadcast_to(c[-1], xb.shape), c[:-1], reverse=True)
     return acc
 
 
 def _chain_powers(f, r, n: int):
-    """[r^1, ..., r^n] stacked on a new axis before the limb axis."""
-    out = [r]
-    for _ in range(n - 1):
-        out.append(f.mul(out[-1], r))
-    return jnp.stack(out, axis=-2)
+    """[r^1, ..., r^n] stacked on a new axis before the limb axis (scan-rolled)."""
+
+    def body(acc, _):
+        nxt = f.mul(acc, r)
+        return nxt, nxt
+
+    _, out = jax.lax.scan(body, f.ones(r.shape[:-1]), None, length=n)
+    return jnp.moveaxis(out, 0, -2)
+
+
+def _inv_fermat(f, x):
+    """Elementwise inverse via a scan-rolled square-and-multiply ladder.
+
+    inv(0) == 0 (harmless: only reachable on bad_t-flagged lanes).
+    """
+    e = f.MODULUS - 2
+    bits = jnp.asarray(np.array([(e >> i) & 1 for i in range(e.bit_length())][::-1],
+                                dtype=bool))
+
+    def body(acc, bit):
+        acc = f.mul(acc, acc)
+        return f.select(jnp.broadcast_to(bit, acc.shape[:-1]), f.mul(acc, x), acc), None
+
+    acc, _ = jax.lax.scan(body, f.ones(x.shape[:-1]), bits)
+    return acc
 
 
 # ---------------------------------------------------------------------------
@@ -250,14 +282,22 @@ class BatchFlp:
         gouts = self._gadget_outs(coeffs)  # [..., m, L]
         v0 = self.circuit.output(gouts, meas_share, joint_rand, num_shares)
 
-        # wire polynomials: evals [seed_w, wire values..., 0...] over the
-        # p2-subgroup -> INTT -> Horner at t
+        # wire polynomials evaluated at t, barycentrically over the
+        # p2-subgroup: evals are [seed_w, wire values..., 0...] at w^0..w^(p2-1).
         wires_t = jnp.swapaxes(wires, -3, -2)  # [..., A, m, L]
         zpad = jnp.zeros(wires_t.shape[:-2] + (p2 - 1 - m, wires_t.shape[-1]),
                          dtype=wires_t.dtype)
         evals = jnp.concatenate([seeds[..., :, None, :], wires_t, zpad], axis=-2)
-        wire_coeffs = f.intt(evals)  # [..., A, p2, L]
-        wire_at_t = _horner(f, wire_coeffs, t[..., None, :], axis=-2)  # [..., A, L]
+        w_int = pow(f.GENERATOR, f.GEN_ORDER // p2, f.MODULUS)
+        w_pows = jnp.asarray(f.pack([pow(w_int, i, f.MODULUS) for i in range(p2)]))
+        denom = f.sub(jnp.broadcast_to(t[..., None, :], t.shape[:-1] + (p2, t.shape[-1])),
+                      jnp.broadcast_to(w_pows, t.shape[:-1] + (p2, t.shape[-1])))
+        d = f.mul(jnp.broadcast_to(w_pows, denom.shape), _inv_fermat(f, denom))
+        # scale = (t^p2 - 1) / p2
+        scale = f.mul_const(f.sub(f.pow_static(t, p2), f.ones(t.shape[:-1])),
+                            pow(p2, f.MODULUS - 2, f.MODULUS))
+        sums = f.sum_mod(f.mul(evals, d[..., None, :, :]), axis=-1)  # [..., A, L]
+        wire_at_t = f.mul(sums, scale[..., None, :])
 
         gpoly_at_t = _horner(f, coeffs, t, axis=-2)  # [..., L]
 

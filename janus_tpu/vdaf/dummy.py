@@ -1,0 +1,127 @@
+"""Dummy VDAF with injectable failures — the analog of prio::vdaf::dummy as
+wrapped by the reference's Fake/FakeFailsPrepInit/FakeFailsPrepStep instances
+(core/src/vdaf.rs:96-108, dispatch :342-390; SURVEY.md §4 tier 4).
+
+A 1-round, 2-party "VDAF" whose measurement is a small integer carried in the
+clear in both input shares; aggregation sums leader-share values.  It
+exercises every code path of the aggregator (ping-pong, state persistence,
+error handling) without real cryptography, and its hooks inject prep-init /
+prep-step failures deterministically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from janus_tpu.vdaf.prio3 import VdafError
+
+
+@dataclass
+class DummyPrepState:
+    input_value: int
+
+
+class DummyVdaf:
+    """Duck-typed subset of the Prio3 oracle surface used by ping_pong and
+    the aggregator."""
+
+    ROUNDS = 1
+    shares = 2
+    VERIFY_KEY_SIZE = 0
+    SEED_SIZE = 0
+    RAND_SIZE = 0
+
+    def __init__(self, fail_prep_init: bool = False, fail_prep_step: bool = False):
+        self.fail_prep_init = fail_prep_init
+        self.fail_prep_step = fail_prep_step
+        self.has_joint_rand = False
+
+    # -- client -----------------------------------------------------------
+
+    def shard(self, measurement: int, nonce: bytes, rand: bytes = b""):
+        if not 0 <= measurement < 256:
+            raise VdafError("dummy measurement out of range")
+        return None, [(measurement,), (measurement,)]
+
+    # -- preparation ------------------------------------------------------
+
+    def prep_init(self, verify_key, agg_id, nonce, public_share, input_share):
+        if self.fail_prep_init:
+            raise VdafError("injected prep-init failure")
+        (value,) = input_share
+        from janus_tpu.vdaf.prio3 import PrepShare, PrepState
+
+        return PrepState([value] if agg_id == 0 else [0], None), PrepShare(None, [value])
+
+    def prep_shares_to_prep(self, prep_shares):
+        from janus_tpu.vdaf.prio3 import PrepMessage
+
+        if self.fail_prep_step:
+            raise VdafError("injected prep-step failure")
+        if len(prep_shares) != 2 or prep_shares[0].verifiers != prep_shares[1].verifiers:
+            raise VdafError("dummy share mismatch")
+        return PrepMessage(None)
+
+    def prep_next(self, state, msg):
+        return state.out_share
+
+    # -- aggregation ------------------------------------------------------
+
+    def aggregate_init(self):
+        return [0]
+
+    def aggregate_update(self, agg_share, out_share):
+        return [agg_share[0] + out_share[0]]
+
+    def unshard(self, agg_shares, num_measurements):
+        return sum(s[0] for s in agg_shares)
+
+    # -- codecs ------------------------------------------------------------
+
+    def encode_public_share(self, public_share) -> bytes:
+        return b""
+
+    def decode_public_share(self, data: bytes):
+        if data:
+            raise VdafError("unexpected public share bytes")
+        return None
+
+    def encode_input_share(self, agg_id, input_share) -> bytes:
+        return bytes([input_share[0]])
+
+    def decode_input_share(self, agg_id, data: bytes):
+        if len(data) != 1:
+            raise VdafError("bad dummy input share")
+        return (data[0],)
+
+    def encode_prep_share(self, ps) -> bytes:
+        return bytes([ps.verifiers[0]])
+
+    def decode_prep_share(self, data: bytes):
+        from janus_tpu.vdaf.prio3 import PrepShare
+
+        if len(data) != 1:
+            raise VdafError("bad dummy prep share")
+        return PrepShare(None, [data[0]])
+
+    def encode_prep_message(self, msg) -> bytes:
+        return b""
+
+    def decode_prep_message(self, data: bytes):
+        from janus_tpu.vdaf.prio3 import PrepMessage
+
+        if data:
+            raise VdafError("unexpected dummy prep message bytes")
+        return PrepMessage(None)
+
+    def encode_out_share(self, out_share) -> bytes:
+        return bytes([out_share[0] & 0xFF])
+
+    def decode_out_share(self, data: bytes):
+        return [data[0]]
+
+    def encode_agg_share(self, agg_share) -> bytes:
+        return int(agg_share[0]).to_bytes(8, "little")
+
+    def decode_agg_share(self, data: bytes):
+        return [int.from_bytes(data, "little")]

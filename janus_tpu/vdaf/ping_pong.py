@@ -1,0 +1,166 @@
+"""Ping-pong topology for 2-party VDAF preparation — Python oracle.
+
+This is the exact surface Janus consumes from prio (SURVEY.md §2.8):
+`leader_initialized` (aggregation_job_driver.rs:345), `helper_initialized`
+(aggregator.rs:1947), `leader_continued` (aggregation_job_driver.rs:589),
+`PingPongTransition::evaluate` (aggregator.rs:1956), with states
+Continued/Finished.  The TPU batch engine (janus_tpu.engine) computes the same
+functions over report batches; this module defines semantics and wire format.
+
+Message wire format (tag byte + u32-length-prefixed fields, little-endian
+lengths as in TLS-syntax u32 opaque):
+
+    initialize(0): prep_share
+    continue (1): prep_msg, prep_share
+    finish   (2): prep_msg
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from janus_tpu.vdaf.prio3 import Prio3, VdafError
+
+
+def _opaque32(data: bytes) -> bytes:
+    return struct.pack(">I", len(data)) + data
+
+
+def _read_opaque32(data: bytes, off: int) -> tuple[bytes, int]:
+    if off + 4 > len(data):
+        raise VdafError("truncated ping-pong message")
+    (n,) = struct.unpack(">I", data[off : off + 4])
+    off += 4
+    if off + n > len(data):
+        raise VdafError("truncated ping-pong message")
+    return data[off : off + n], off + n
+
+
+@dataclass
+class PingPongMessage:
+    TYPE_INITIALIZE = 0
+    TYPE_CONTINUE = 1
+    TYPE_FINISH = 2
+
+    type: int
+    prep_share: bytes | None = None
+    prep_msg: bytes | None = None
+
+    def encode(self) -> bytes:
+        if self.type == self.TYPE_INITIALIZE:
+            return bytes([self.type]) + _opaque32(self.prep_share)
+        if self.type == self.TYPE_CONTINUE:
+            return bytes([self.type]) + _opaque32(self.prep_msg) + _opaque32(self.prep_share)
+        if self.type == self.TYPE_FINISH:
+            return bytes([self.type]) + _opaque32(self.prep_msg)
+        raise VdafError(f"bad ping-pong message type {self.type}")
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PingPongMessage":
+        if not data:
+            raise VdafError("empty ping-pong message")
+        t, off = data[0], 1
+        if t == cls.TYPE_INITIALIZE:
+            share, off = _read_opaque32(data, off)
+            msg = cls(t, prep_share=share)
+        elif t == cls.TYPE_CONTINUE:
+            pm, off = _read_opaque32(data, off)
+            share, off = _read_opaque32(data, off)
+            msg = cls(t, prep_share=share, prep_msg=pm)
+        elif t == cls.TYPE_FINISH:
+            pm, off = _read_opaque32(data, off)
+            msg = cls(t, prep_msg=pm)
+        else:
+            raise VdafError(f"bad ping-pong message type {t}")
+        if off != len(data):
+            raise VdafError("trailing bytes in ping-pong message")
+        return msg
+
+
+@dataclass
+class PingPongContinued:
+    """Mid-preparation state: our prep state, awaiting the peer's message."""
+
+    prep_state: object
+    current_round: int
+
+    finished = False
+
+
+@dataclass
+class PingPongFinished:
+    out_share: list
+
+    finished = True
+
+
+@dataclass
+class PingPongTransition:
+    """A deferred (prep_state, prep_msg) pair; evaluate() applies prep_next.
+
+    Janus serializes these into report_aggregations rows
+    (WaitingLeader{transition} — datastore/models.rs:855); encode/decode use
+    the VDAF codecs so the bytes are stable across processes.
+    """
+
+    vdaf: Prio3
+    prep_state: object
+    prep_msg_bytes: bytes
+    current_round: int
+
+    def evaluate(self) -> tuple[object, PingPongMessage]:
+        msg = self.vdaf.decode_prep_message(self.prep_msg_bytes)
+        if self.current_round + 1 == self.vdaf.ROUNDS:
+            out_share = self.vdaf.prep_next(self.prep_state, msg)
+            return (
+                PingPongFinished(out_share),
+                PingPongMessage(PingPongMessage.TYPE_FINISH, prep_msg=self.prep_msg_bytes),
+            )
+        raise NotImplementedError("multi-round VDAFs not yet supported")
+
+
+def leader_initialized(
+    vdaf: Prio3, verify_key: bytes, nonce: bytes, public_share, input_share
+) -> tuple[PingPongContinued, PingPongMessage]:
+    """Leader side of round 0: -> (state, outbound initialize message)."""
+    state, prep_share = vdaf.prep_init(verify_key, 0, nonce, public_share, input_share)
+    return (
+        PingPongContinued(state, 0),
+        PingPongMessage(
+            PingPongMessage.TYPE_INITIALIZE, prep_share=vdaf.encode_prep_share(prep_share)
+        ),
+    )
+
+
+def helper_initialized(
+    vdaf: Prio3,
+    verify_key: bytes,
+    nonce: bytes,
+    public_share,
+    input_share,
+    inbound: PingPongMessage,
+) -> PingPongTransition:
+    """Helper side of round 0: consume the leader's initialize message.
+
+    Returns a transition; evaluate() yields (Finished(out_share),
+    finish message) for 1-round VDAFs.  Raises VdafError on a bad proof.
+    """
+    if inbound.type != PingPongMessage.TYPE_INITIALIZE:
+        raise VdafError("helper_initialized requires an initialize message")
+    state, helper_share = vdaf.prep_init(verify_key, 1, nonce, public_share, input_share)
+    leader_share = vdaf.decode_prep_share(inbound.prep_share)
+    prep_msg = vdaf.prep_shares_to_prep([leader_share, helper_share])
+    return PingPongTransition(vdaf, state, vdaf.encode_prep_message(prep_msg), 0)
+
+
+def leader_continued(
+    vdaf: Prio3, state: PingPongContinued, inbound: PingPongMessage
+) -> PingPongFinished:
+    """Leader consumes the helper's finish message; raises on mismatch."""
+    if inbound.type == PingPongMessage.TYPE_FINISH:
+        if state.current_round + 1 != vdaf.ROUNDS:
+            raise VdafError("peer finished early")
+        msg = vdaf.decode_prep_message(inbound.prep_msg)
+        return PingPongFinished(vdaf.prep_next(state.prep_state, msg))
+    raise NotImplementedError("multi-round VDAFs not yet supported")

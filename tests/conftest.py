@@ -24,3 +24,7 @@ try:
     clear_backends()
 except Exception:  # pragma: no cover - best effort against older jax
     pass
+
+import janus_tpu  # noqa: E402
+
+janus_tpu.enable_compilation_cache()

@@ -1,0 +1,159 @@
+"""Core runtime: HPKE, clocks, auth tokens, retries, VDAF registry."""
+
+import pytest
+
+from janus_tpu.core import hpke
+from janus_tpu.core.auth_tokens import (
+    AuthenticationToken,
+    AuthenticationTokenHash,
+    extract_bearer_token,
+)
+from janus_tpu.core.retries import (
+    Backoff,
+    HttpResult,
+    LimitedRetryer,
+    is_retryable_http_status,
+    retry_http_request,
+)
+from janus_tpu.core.time import MockClock, RealClock
+from janus_tpu.messages import Duration, HpkeAeadId, HpkeKdfId, HpkeKemId, Role, Time
+from janus_tpu.models import VdafInstance, dispatch
+
+
+@pytest.mark.parametrize("kem", [HpkeKemId.X25519_HKDF_SHA256, HpkeKemId.P256_HKDF_SHA256])
+@pytest.mark.parametrize("aead", [
+    HpkeAeadId.AES_128_GCM, HpkeAeadId.AES_256_GCM, HpkeAeadId.CHACHA20_POLY1305,
+])
+def test_hpke_roundtrip(kem, aead):
+    kp = hpke.HpkeKeypair.generate(7, kem_id=kem, aead_id=aead)
+    info = hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+    ct = hpke.seal(kp.config, info, b"plaintext measurement", b"associated data")
+    assert ct.config_id.value == 7
+    got = hpke.open_ciphertext(kp, info, ct, b"associated data")
+    assert got == b"plaintext measurement"
+
+
+def test_hpke_open_rejects_tampering():
+    kp = hpke.HpkeKeypair.generate(1)
+    info = hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+    ct = hpke.seal(kp.config, info, b"secret", b"aad")
+    bad_payload = hpke.HpkeCiphertext(ct.config_id, ct.encapsulated_key,
+                                      bytes([ct.payload[0] ^ 1]) + ct.payload[1:])
+    with pytest.raises(hpke.HpkeError):
+        hpke.open_ciphertext(kp, info, bad_payload, b"aad")
+    with pytest.raises(hpke.HpkeError):
+        hpke.open_ciphertext(kp, info, ct, b"different aad")
+    other_info = hpke.application_info(hpke.Label.AGGREGATE_SHARE, Role.CLIENT, Role.LEADER)
+    with pytest.raises(hpke.HpkeError):
+        hpke.open_ciphertext(kp, other_info, ct, b"aad")
+
+
+def test_hpke_wrong_key_fails():
+    kp1 = hpke.HpkeKeypair.generate(1)
+    kp2 = hpke.HpkeKeypair.generate(1)
+    info = hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT, Role.HELPER)
+    ct = hpke.seal(kp1.config, info, b"x", b"")
+    with pytest.raises(hpke.HpkeError):
+        hpke.open_ciphertext(kp2, info, ct, b"")
+
+
+def test_hpke_supported_check():
+    kp = hpke.HpkeKeypair.generate(1)
+    assert hpke.is_hpke_config_supported(kp.config)
+    unsupported = hpke.HpkeConfig(
+        kp.config.id, HpkeKemId(0x9999), kp.config.kdf_id, kp.config.aead_id,
+        kp.config.public_key,
+    )
+    assert not hpke.is_hpke_config_supported(unsupported)
+    with pytest.raises(hpke.HpkeError):
+        hpke.seal(unsupported, b"info", b"pt", b"aad")
+
+
+def test_clocks():
+    clock = MockClock(Time(1000))
+    assert clock.now() == Time(1000)
+    clock.advance(Duration(500))
+    assert clock.now() == Time(1500)
+    clock.set(Time(99))
+    assert clock.now() == Time(99)
+    assert RealClock().now().seconds > 1_700_000_000
+
+
+def test_auth_tokens():
+    tok = AuthenticationToken.bearer("abc123")
+    assert tok.request_headers() == {"Authorization": "Bearer abc123"}
+    assert extract_bearer_token(tok.request_headers()) == "abc123"
+    h = AuthenticationTokenHash.of(tok)
+    assert h.matches(tok)
+    assert not h.matches(AuthenticationToken.bearer("abc124"))
+    assert not h.matches(AuthenticationToken.dap_auth("abc123"))
+    dap = AuthenticationToken.random_dap_auth()
+    assert dap.request_headers()["DAP-Auth-Token"] == dap.token
+    with pytest.raises(ValueError):
+        AuthenticationToken.dap_auth("has space")
+
+
+def test_retries():
+    assert is_retryable_http_status(500) and is_retryable_http_status(429)
+    assert not is_retryable_http_status(404)
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("connection refused")
+        return HttpResult(200, {}, b"ok")
+
+    result = retry_http_request(flaky, Backoff(0.0001, 0.001, 2, 1.0), sleep=lambda s: None)
+    assert result.status == 200 and len(calls) == 3
+
+    calls.clear()
+
+    def always_503():
+        calls.append(1)
+        return HttpResult(503, {}, b"")
+
+    result = retry_http_request(always_503, LimitedRetryer(2), sleep=lambda s: None)
+    assert result.status == 503 and len(calls) == 2
+
+
+def test_vdaf_instance_serde():
+    inst = VdafInstance.prio3_sum(32)
+    assert inst.to_json_obj() == {"Prio3Sum": {"bits": 32}}
+    assert VdafInstance.from_json_obj({"Prio3Sum": {"bits": 32}}) == inst
+    assert VdafInstance.from_json_obj("Prio3Count") == VdafInstance.prio3_count()
+    sv = VdafInstance.prio3_sum_vec(1, 10, 4)
+    assert VdafInstance.from_json_obj(sv.to_json_obj()) == sv
+    assert sv.bits == 1 and sv.length == 10 and sv.chunk_length == 4
+    assert VdafInstance.prio3_count().verify_key_length == 16
+    assert VdafInstance.prio3_sum_vec_field64_multiproof_hmac_sha256_aes128(
+        2, 1, 10, 4).verify_key_length == 32
+    with pytest.raises(ValueError):
+        VdafInstance("NotAVdaf")
+    with pytest.raises(ValueError):
+        VdafInstance("Prio3Sum")  # missing params
+
+
+def test_dispatch_fake_vdafs():
+    vdaf, engine = dispatch(VdafInstance.fake())
+    _, shares = vdaf.shard(7, b"\x00" * 16)
+    enc = [vdaf.encode_input_share(i, s) for i, s in enumerate(shares)]
+    leader = engine.leader_init_batch(b"", [b"\x00" * 16], [b""], [enc[0]])
+    assert leader[0].status == "continued"
+    helper = engine.helper_init_batch(b"", [b"\x00" * 16], [b""], [enc[1]],
+                                      [leader[0].outbound])
+    assert helper[0].status == "finished"
+    done = engine.leader_finish(leader, [helper[0].outbound])
+    assert done[0].status == "finished"
+    assert engine.aggregate(done) == [7]
+
+    _, fail_engine = dispatch(VdafInstance.fake_fails_prep_init())
+    res = fail_engine.helper_init_batch(b"", [b"\x00" * 16], [b""], [enc[1]],
+                                        [leader[0].outbound])
+    assert res[0].status == "failed"
+
+    _, fail_step = dispatch(VdafInstance.fake_fails_prep_step())
+    res = fail_step.helper_init_batch(b"", [b"\x00" * 16], [b""], [enc[1]],
+                                      [leader[0].outbound])
+    assert res[0].status == "failed"

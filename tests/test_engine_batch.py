@@ -1,0 +1,188 @@
+"""Batch engine vs the transcript oracle: bit-exact prepare for every config."""
+
+import os
+
+import numpy as np
+import pytest
+
+from janus_tpu.engine import BatchPrio3
+from janus_tpu.vdaf import ping_pong, prio3
+from janus_tpu.vdaf.transcript import run_vdaf
+
+CONFIGS = [
+    ("count", prio3.new_count, (), [0, 1, 1, 0, 1]),
+    ("sum8", lambda: prio3.new_sum(8), (), [0, 255, 17, 4, 200]),
+    ("sumvec", lambda: prio3.new_sum_vec(3, 2, 2), (),
+     [[0, 1, 3], [2, 2, 0], [1, 0, 1], [3, 3, 3]]),
+    ("histogram", lambda: prio3.new_histogram(4, 2), (), [0, 1, 2, 3, 2]),
+    ("multiproof", lambda: prio3.new_sum_vec_field64_multiproof_hmac(2, 2, 2, 2), (),
+     [[0, 1], [3, 2], [1, 1]]),
+]
+
+
+def _rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.mark.parametrize("name,mk,_,measurements", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_helper_init_matches_transcripts(name, mk, _, measurements):
+    vdaf = mk()
+    rng = _rng()
+    verify_key = rng.bytes(vdaf.VERIFY_KEY_SIZE)
+    transcripts = [
+        run_vdaf(vdaf, verify_key, m, nonce=rng.bytes(16), rand=rng.bytes(vdaf.RAND_SIZE))
+        for m in measurements
+    ]
+    engine = BatchPrio3(vdaf)
+    inbound = [
+        ping_pong.PingPongMessage(
+            ping_pong.PingPongMessage.TYPE_INITIALIZE,
+            prep_share=t.encoded_prep_shares[0],
+        )
+        for t in transcripts
+    ]
+    results = engine.helper_init_batch(
+        verify_key,
+        [t.nonce for t in transcripts],
+        [t.encoded_public_share for t in transcripts],
+        [t.encoded_input_shares[1] for t in transcripts],
+        inbound,
+    )
+    for t, rep in zip(transcripts, results):
+        assert rep.status == "finished", rep.error
+        assert rep.outbound.type == ping_pong.PingPongMessage.TYPE_FINISH
+        assert rep.outbound.prep_msg == t.encoded_prep_message
+        if rep.prep_share is not None:
+            assert rep.prep_share == t.encoded_prep_shares[1]
+        got_out = engine._raw_to_ints(rep.out_share_raw)
+        assert got_out == t.out_shares[1]
+
+
+@pytest.mark.parametrize("name,mk,_,measurements", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_leader_init_and_finish_matches_transcripts(name, mk, _, measurements):
+    vdaf = mk()
+    rng = _rng()
+    verify_key = rng.bytes(vdaf.VERIFY_KEY_SIZE)
+    transcripts = [
+        run_vdaf(vdaf, verify_key, m, nonce=rng.bytes(16), rand=rng.bytes(vdaf.RAND_SIZE))
+        for m in measurements
+    ]
+    engine = BatchPrio3(vdaf)
+    results = engine.leader_init_batch(
+        verify_key,
+        [t.nonce for t in transcripts],
+        [t.encoded_public_share for t in transcripts],
+        [t.encoded_input_shares[0] for t in transcripts],
+    )
+    for t, rep in zip(transcripts, results):
+        assert rep.status == "continued", rep.error
+        assert rep.outbound.type == ping_pong.PingPongMessage.TYPE_INITIALIZE
+        assert rep.outbound.prep_share == t.encoded_prep_shares[0]
+
+    finish = [
+        ping_pong.PingPongMessage(
+            ping_pong.PingPongMessage.TYPE_FINISH, prep_msg=t.encoded_prep_message
+        )
+        for t in transcripts
+    ]
+    done = engine.leader_finish(results, finish)
+    for t, rep in zip(transcripts, done):
+        assert rep.status == "finished", rep.error
+        assert engine._raw_to_ints(rep.out_share_raw) == t.out_shares[0]
+
+
+def test_end_to_end_aggregate():
+    vdaf = prio3.new_histogram(4, 2)
+    rng = _rng()
+    verify_key = rng.bytes(16)
+    measurements = [0, 1, 1, 3, 2, 1]
+    transcripts = [
+        run_vdaf(vdaf, verify_key, m, nonce=rng.bytes(16), rand=rng.bytes(vdaf.RAND_SIZE))
+        for m in measurements
+    ]
+    engine = BatchPrio3(vdaf)
+    leader = engine.leader_init_batch(
+        verify_key,
+        [t.nonce for t in transcripts],
+        [t.encoded_public_share for t in transcripts],
+        [t.encoded_input_shares[0] for t in transcripts],
+    )
+    helper = engine.helper_init_batch(
+        verify_key,
+        [t.nonce for t in transcripts],
+        [t.encoded_public_share for t in transcripts],
+        [t.encoded_input_shares[1] for t in transcripts],
+        [r.outbound for r in leader],
+    )
+    leader_done = engine.leader_finish(leader, [r.outbound for r in helper])
+    agg_l = engine.aggregate(leader_done)
+    agg_h = engine.aggregate(helper)
+    result = vdaf.unshard([agg_l, agg_h], len(measurements))
+    want = [measurements.count(i) for i in range(4)]
+    assert result == want
+
+
+def test_tampered_proof_fails_only_that_report():
+    vdaf = prio3.new_sum(4)
+    rng = _rng()
+    verify_key = rng.bytes(16)
+    transcripts = [
+        run_vdaf(vdaf, verify_key, m, nonce=rng.bytes(16), rand=rng.bytes(vdaf.RAND_SIZE))
+        for m in [1, 2, 3]
+    ]
+    engine = BatchPrio3(vdaf)
+    inbound = []
+    for i, t in enumerate(transcripts):
+        share = bytearray(t.encoded_prep_shares[0])
+        if i == 1:  # corrupt one verifier byte of report 1
+            share[20] ^= 0xFF
+        inbound.append(ping_pong.PingPongMessage(
+            ping_pong.PingPongMessage.TYPE_INITIALIZE, prep_share=bytes(share)))
+    results = engine.helper_init_batch(
+        verify_key,
+        [t.nonce for t in transcripts],
+        [t.encoded_public_share for t in transcripts],
+        [t.encoded_input_shares[1] for t in transcripts],
+        inbound,
+    )
+    assert results[0].status == "finished"
+    assert results[1].status == "failed"
+    assert results[2].status == "finished"
+
+
+def test_garbage_input_share_fails_cleanly():
+    vdaf = prio3.new_count()
+    rng = _rng()
+    verify_key = rng.bytes(16)
+    t = run_vdaf(vdaf, verify_key, 1, nonce=rng.bytes(16), rand=rng.bytes(vdaf.RAND_SIZE))
+    engine = BatchPrio3(vdaf)
+    inbound = ping_pong.PingPongMessage(
+        ping_pong.PingPongMessage.TYPE_INITIALIZE, prep_share=t.encoded_prep_shares[0])
+    results = engine.helper_init_batch(
+        verify_key, [t.nonce], [t.encoded_public_share], [b"short"], [inbound]
+    )
+    assert results[0].status == "failed"
+
+
+def test_host_and_device_paths_agree_on_pingpong_oracle():
+    """The ping-pong oracle itself round-trips (used for multiproof fallback)."""
+    vdaf = prio3.new_sum_vec_field64_multiproof_hmac(2, 2, 2, 2)
+    rng = _rng()
+    verify_key = rng.bytes(32)
+    t = run_vdaf(vdaf, verify_key, [1, 2], nonce=rng.bytes(16),
+                 rand=rng.bytes(vdaf.RAND_SIZE))
+    pub = vdaf.decode_public_share(t.encoded_public_share)
+    l_state, l_msg = ping_pong.leader_initialized(
+        vdaf, verify_key, t.nonce, pub, vdaf.decode_input_share(0, t.encoded_input_shares[0])
+    )
+    transition = ping_pong.helper_initialized(
+        vdaf, verify_key, t.nonce, pub,
+        vdaf.decode_input_share(1, t.encoded_input_shares[1]),
+        ping_pong.PingPongMessage.decode(l_msg.encode()),
+    )
+    h_state, h_msg = transition.evaluate()
+    assert h_state.out_share == t.out_shares[1]
+    finished = ping_pong.leader_continued(
+        vdaf, l_state, ping_pong.PingPongMessage.decode(h_msg.encode())
+    )
+    assert finished.out_share == t.out_shares[0]
