@@ -1,0 +1,27 @@
+"""State layer: transactional datastore, domain models, task config
+(reference aggregator_core/ — SURVEY.md §2.4).
+
+"The database is the checkpoint": every protocol step persists complete
+resumable state here, so any replica can resume any job and device memory is
+always disposable (SURVEY.md §5.4).
+"""
+
+from janus_tpu.datastore.datastore import (
+    Crypter,
+    Datastore,
+    DatastoreError,
+    MutationTargetAlreadyExists,
+    MutationTargetNotFound,
+    SerializationConflict,
+    SqliteBackend,
+    Transaction,
+    ephemeral_datastore,
+)
+from janus_tpu.datastore.task import AggregatorTask, QueryTypeCfg, TaskBuilder
+
+__all__ = [
+    "Crypter", "Datastore", "DatastoreError", "MutationTargetAlreadyExists",
+    "MutationTargetNotFound", "SerializationConflict", "SqliteBackend",
+    "Transaction", "ephemeral_datastore", "AggregatorTask", "QueryTypeCfg",
+    "TaskBuilder",
+]

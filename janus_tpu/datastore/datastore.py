@@ -1,0 +1,1210 @@
+"""The transactional state layer (reference aggregator_core/src/datastore.rs).
+
+- `Datastore.run_tx(name, fn)`: run `fn(tx)` inside a transaction; on a
+  serialization conflict the whole closure re-runs, up to
+  max_transaction_retries (reference datastore.rs:232-283).  Closures must be
+  idempotent and must NOT launch device work (SURVEY.md §7 hard part 6).
+- `Transaction` exposes the typed query surface (reference datastore.rs:405).
+- `Crypter`: AES-128-GCM encryption of sensitive columns with
+  AAD = (table, row key, column) and key rotation (reference datastore.rs:5133).
+- Lease acquisition emulates `FOR UPDATE SKIP LOCKED` (reference
+  datastore.rs:1755): atomic claim of expired-lease jobs with random lease
+  tokens; works on sqlite's single-writer model and on Postgres.
+
+The default backend is sqlite (always available; used by tests and
+single-node deployments).  A Postgres backend can register over the same
+`_Backend` seam — the SQL below sticks to the common subset.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time as _time
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from janus_tpu.core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import Clock
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.schema import SCHEMA_VERSION, TABLES
+from janus_tpu.datastore.task import AggregatorTask, QueryTypeCfg
+from janus_tpu.messages import (
+    AggregationJobId,
+    AggregationJobStep,
+    BatchId,
+    CollectionJobId,
+    Duration,
+    Extension,
+    HpkeCiphertext,
+    HpkeConfig,
+    Interval,
+    PrepareError,
+    PrepareResp,
+    Query,
+    ReportId,
+    ReportIdChecksum,
+    Role,
+    TaskId,
+    Time,
+)
+from janus_tpu.models import VdafInstance
+
+
+class DatastoreError(Exception):
+    pass
+
+
+class SerializationConflict(DatastoreError):
+    """Transaction must be retried."""
+
+
+class MutationTargetAlreadyExists(DatastoreError):
+    """Idempotency signal: an INSERT found an existing conflicting row
+    (reference datastore.rs:5239)."""
+
+
+class MutationTargetNotFound(DatastoreError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Crypter
+# ---------------------------------------------------------------------------
+
+
+class Crypter:
+    """AES-128-GCM column encryption with key rotation
+    (reference datastore.rs:5133): first key encrypts, all keys decrypt."""
+
+    KEY_SIZE = 16
+    NONCE_SIZE = 12
+
+    def __init__(self, keys: list[bytes]):
+        assert keys and all(len(k) == self.KEY_SIZE for k in keys)
+        self._aeads = [AESGCM(k) for k in keys]
+
+    @classmethod
+    def generate(cls) -> "Crypter":
+        return cls([os.urandom(cls.KEY_SIZE)])
+
+    @staticmethod
+    def aad(table: str, row_key: bytes, column: str) -> bytes:
+        return table.encode() + b"/" + row_key + b"/" + column.encode()
+
+    def encrypt(self, table: str, row_key: bytes, column: str, value: bytes) -> bytes:
+        nonce = os.urandom(self.NONCE_SIZE)
+        return nonce + self._aeads[0].encrypt(nonce, value, self.aad(table, row_key, column))
+
+    def decrypt(self, table: str, row_key: bytes, column: str, value: bytes) -> bytes:
+        nonce, ct = value[: self.NONCE_SIZE], value[self.NONCE_SIZE :]
+        aad = self.aad(table, row_key, column)
+        for aead in self._aeads:
+            try:
+                return aead.decrypt(nonce, ct, aad)
+            except Exception:
+                continue
+        raise DatastoreError(f"cannot decrypt {table}.{column}")
+
+
+# ---------------------------------------------------------------------------
+# backend
+# ---------------------------------------------------------------------------
+
+
+class SqliteBackend:
+    """Connection factory for sqlite; in-memory (shared) or file-backed."""
+
+    def __init__(self, path: str | None = None):
+        if path is None:
+            # Shared in-memory DB: keep a holder connection alive.
+            self._uri = f"file:janus_{id(self)}_{os.urandom(4).hex()}?mode=memory&cache=shared"
+            self._holder = sqlite3.connect(self._uri, uri=True, check_same_thread=False)
+        else:
+            self._uri = f"file:{path}"
+            self._holder = None
+
+    def connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self._uri, uri=True, timeout=10.0,
+                               check_same_thread=False)
+        conn.execute("PRAGMA foreign_keys = ON")
+        return conn
+
+
+# ---------------------------------------------------------------------------
+# Datastore
+# ---------------------------------------------------------------------------
+
+
+class Datastore:
+    def __init__(self, backend: SqliteBackend, crypter: Crypter, clock: Clock,
+                 max_transaction_retries: int = 10):
+        self.backend = backend
+        self.crypter = crypter
+        self.clock = clock
+        self.max_transaction_retries = max_transaction_retries
+        self._write_lock = threading.Lock()
+        self.tx_retry_count = 0  # observability (reference tx metrics :237-283)
+
+    def put_schema(self) -> None:
+        conn = self.backend.connect()
+        try:
+            with conn:
+                for ddl in TABLES:
+                    conn.execute(ddl)
+                conn.execute("INSERT INTO schema_version (version) VALUES (?)",
+                             (SCHEMA_VERSION,))
+        finally:
+            conn.close()
+
+    def check_schema_version(self) -> None:
+        conn = self.backend.connect()
+        try:
+            row = conn.execute("SELECT MAX(version) FROM schema_version").fetchone()
+            if row is None or row[0] != SCHEMA_VERSION:
+                raise DatastoreError(f"schema version mismatch: {row}")
+        finally:
+            conn.close()
+
+    def run_tx(self, name: str, fn):
+        """Run fn(tx) transactionally with serialization retry
+        (reference datastore.rs:232)."""
+        last = None
+        for _attempt in range(self.max_transaction_retries):
+            conn = self.backend.connect()
+            try:
+                conn.execute("BEGIN IMMEDIATE")
+                tx = Transaction(self, conn, name)
+                result = fn(tx)
+                conn.commit()
+                return result
+            except sqlite3.OperationalError as e:
+                conn.rollback()
+                if "locked" in str(e) or "busy" in str(e):
+                    self.tx_retry_count += 1
+                    last = SerializationConflict(str(e))
+                    _time.sleep(0.01)
+                    continue
+                raise DatastoreError(str(e)) from e
+            except SerializationConflict as e:
+                conn.rollback()
+                self.tx_retry_count += 1
+                last = e
+                continue
+            except Exception:
+                conn.rollback()
+                raise
+            finally:
+                conn.close()
+        raise last if last else DatastoreError("transaction retries exhausted")
+
+
+@dataclass
+class _TaskRowCache:
+    query_type: QueryTypeCfg
+    vdaf: VdafInstance
+
+
+class Transaction:
+    """Typed query surface over one open transaction."""
+
+    def __init__(self, ds: Datastore, conn: sqlite3.Connection, name: str):
+        self.ds = ds
+        self.conn = conn
+        self.name = name
+        self.crypter = ds.crypter
+        self.clock = ds.clock
+
+    # -- helpers ----------------------------------------------------------
+
+    def _exec(self, sql: str, params=()):
+        return self.conn.execute(sql, params)
+
+    def _now(self) -> int:
+        return self.clock.now().seconds
+
+    # -- tasks ------------------------------------------------------------
+
+    def put_aggregator_task(self, task: AggregatorTask) -> None:
+        tid = bytes(task.task_id)
+        vk = self.crypter.encrypt("tasks", tid, "vdaf_verify_key", task.vdaf_verify_key)
+        agg_tok = None
+        if task.aggregator_auth_token is not None:
+            agg_tok = json.dumps({
+                "kind": "token", "type": task.aggregator_auth_token.token_type,
+                "token": task.aggregator_auth_token.token,
+            }).encode()
+        elif task.aggregator_auth_token_hash is not None:
+            agg_tok = json.dumps({
+                "kind": "hash", "type": task.aggregator_auth_token_hash.token_type,
+                "digest": task.aggregator_auth_token_hash.digest.hex(),
+            }).encode()
+        if agg_tok is not None:
+            agg_tok = self.crypter.encrypt("tasks", tid, "aggregator_auth_token", agg_tok)
+        col_tok = None
+        if task.collector_auth_token_hash is not None:
+            col_tok = self.crypter.encrypt(
+                "tasks", tid, "collector_auth_token",
+                json.dumps({
+                    "kind": "hash", "type": task.collector_auth_token_hash.token_type,
+                    "digest": task.collector_auth_token_hash.digest.hex(),
+                }).encode(),
+            )
+        try:
+            self._exec(
+                """INSERT INTO tasks (task_id, aggregator_role,
+                    peer_aggregator_endpoint, query_type, vdaf, vdaf_verify_key,
+                    task_expiration, report_expiry_age, min_batch_size,
+                    time_precision, tolerable_clock_skew, collector_hpke_config,
+                    aggregator_auth_token, collector_auth_token, created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    tid, int(task.role), task.peer_aggregator_endpoint,
+                    json.dumps(task.query_type.to_json_obj()),
+                    json.dumps(task.vdaf.to_json_obj()), vk,
+                    task.task_expiration.seconds if task.task_expiration else None,
+                    task.report_expiry_age.seconds if task.report_expiry_age else None,
+                    task.min_batch_size, task.time_precision.seconds,
+                    task.tolerable_clock_skew.seconds,
+                    task.collector_hpke_config.encode()
+                    if task.collector_hpke_config else None,
+                    agg_tok, col_tok, self._now(),
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+        for kp in task.hpke_keys:
+            self._exec(
+                """INSERT INTO task_hpke_keys (task_id, config_id, config, private_key)
+                   VALUES (?,?,?,?)""",
+                (tid, kp.config.id.value, kp.config.encode(),
+                 self.crypter.encrypt("task_hpke_keys", tid, "private_key",
+                                      kp.private_key)),
+            )
+
+    def get_aggregator_task(self, task_id: TaskId) -> AggregatorTask | None:
+        tid = bytes(task_id)
+        row = self._exec(
+            """SELECT aggregator_role, peer_aggregator_endpoint, query_type, vdaf,
+                      vdaf_verify_key, task_expiration, report_expiry_age,
+                      min_batch_size, time_precision, tolerable_clock_skew,
+                      collector_hpke_config, aggregator_auth_token,
+                      collector_auth_token
+               FROM tasks WHERE task_id = ?""",
+            (tid,),
+        ).fetchone()
+        if row is None:
+            return None
+        return self._task_from_row(task_id, row)
+
+    def get_aggregator_tasks(self) -> list[AggregatorTask]:
+        rows = self._exec(
+            """SELECT task_id, aggregator_role, peer_aggregator_endpoint, query_type,
+                      vdaf, vdaf_verify_key, task_expiration, report_expiry_age,
+                      min_batch_size, time_precision, tolerable_clock_skew,
+                      collector_hpke_config, aggregator_auth_token,
+                      collector_auth_token
+               FROM tasks"""
+        ).fetchall()
+        return [self._task_from_row(TaskId(r[0]), r[1:]) for r in rows]
+
+    def _task_from_row(self, task_id: TaskId, row) -> AggregatorTask:
+        tid = bytes(task_id)
+        (role, endpoint, qt_json, vdaf_json, vk_enc, expiry, expiry_age, min_bs,
+         precision, skew, collector_cfg, agg_tok_enc, col_tok_enc) = row
+        agg_token = agg_hash = col_hash = None
+        if agg_tok_enc is not None:
+            obj = json.loads(self.crypter.decrypt(
+                "tasks", tid, "aggregator_auth_token", agg_tok_enc))
+            if obj["kind"] == "token":
+                agg_token = AuthenticationToken(obj["type"], obj["token"])
+            else:
+                agg_hash = AuthenticationTokenHash(obj["type"], bytes.fromhex(obj["digest"]))
+        if col_tok_enc is not None:
+            obj = json.loads(self.crypter.decrypt(
+                "tasks", tid, "collector_auth_token", col_tok_enc))
+            col_hash = AuthenticationTokenHash(obj["type"], bytes.fromhex(obj["digest"]))
+        keys = []
+        for cfg_blob, sk_enc in self._exec(
+            "SELECT config, private_key FROM task_hpke_keys WHERE task_id = ?", (tid,)
+        ).fetchall():
+            keys.append(HpkeKeypair(
+                HpkeConfig.decode(cfg_blob),
+                self.crypter.decrypt("task_hpke_keys", tid, "private_key", sk_enc),
+            ))
+        return AggregatorTask(
+            task_id=task_id,
+            peer_aggregator_endpoint=endpoint,
+            query_type=QueryTypeCfg.from_json_obj(json.loads(qt_json)),
+            vdaf=VdafInstance.from_json_obj(json.loads(vdaf_json)),
+            role=Role(role),
+            vdaf_verify_key=self.crypter.decrypt("tasks", tid, "vdaf_verify_key", vk_enc),
+            min_batch_size=min_bs,
+            time_precision=Duration(precision),
+            tolerable_clock_skew=Duration(skew),
+            task_expiration=Time(expiry) if expiry is not None else None,
+            report_expiry_age=Duration(expiry_age) if expiry_age is not None else None,
+            collector_hpke_config=HpkeConfig.decode(collector_cfg)
+            if collector_cfg else None,
+            aggregator_auth_token=agg_token,
+            aggregator_auth_token_hash=agg_hash,
+            collector_auth_token_hash=col_hash,
+            hpke_keys=tuple(keys),
+        )
+
+    def delete_task(self, task_id: TaskId) -> None:
+        cur = self._exec("DELETE FROM tasks WHERE task_id = ?", (bytes(task_id),))
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound(f"no task {task_id}")
+
+    # -- client reports ---------------------------------------------------
+
+    def put_client_report(self, report: m.LeaderStoredReport) -> None:
+        """Leader upload path; raises MutationTargetAlreadyExists on a
+        conflicting duplicate (reference datastore.rs:1424)."""
+        tid = bytes(report.task_id)
+        rid = bytes(report.metadata.report_id)
+        enc_share = self.crypter.encrypt(
+            "client_reports", tid + rid, "leader_input_share", report.leader_input_share
+        )
+        ext = b"".join(e.encode() for e in report.leader_extensions)
+        try:
+            self._exec(
+                """INSERT INTO client_reports (task_id, report_id, client_timestamp,
+                     extensions, public_share, leader_input_share,
+                     helper_encrypted_input_share)
+                   VALUES (?,?,?,?,?,?,?)""",
+                (tid, rid, report.metadata.time.seconds, ext, report.public_share,
+                 enc_share, report.helper_encrypted_input_share.encode()),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def put_scrubbed_report(self, task_id: TaskId, report_id: ReportId,
+                            timestamp: Time) -> None:
+        """Helper side: record a report share's existence for replay detection
+        (reference put_report_share, datastore.rs:1605)."""
+        try:
+            self._exec(
+                """INSERT INTO client_reports (task_id, report_id, client_timestamp,
+                     aggregation_started) VALUES (?,?,?,1)""",
+                (bytes(task_id), bytes(report_id), timestamp.seconds),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def check_report_exists(self, task_id: TaskId, report_id: ReportId) -> bool:
+        return self._exec(
+            "SELECT 1 FROM client_reports WHERE task_id = ? AND report_id = ?",
+            (bytes(task_id), bytes(report_id)),
+        ).fetchone() is not None
+
+    def get_client_report(self, task_id: TaskId, report_id: ReportId):
+        tid, rid = bytes(task_id), bytes(report_id)
+        row = self._exec(
+            """SELECT client_timestamp, extensions, public_share, leader_input_share,
+                      helper_encrypted_input_share
+               FROM client_reports WHERE task_id = ? AND report_id = ?""",
+            (tid, rid),
+        ).fetchone()
+        if row is None or row[3] is None:
+            return None
+        ts, ext_blob, public_share, enc_share, helper_blob = row
+        from janus_tpu.messages import ReportMetadata
+        from janus_tpu.messages.codec import Cursor
+
+        extensions = []
+        cur = Cursor(ext_blob or b"")
+        while cur.remaining():
+            extensions.append(Extension.decode_from(cur))
+        return m.LeaderStoredReport(
+            task_id=task_id,
+            metadata=ReportMetadata(report_id, Time(ts)),
+            public_share=public_share,
+            leader_extensions=tuple(extensions),
+            leader_input_share=self.crypter.decrypt(
+                "client_reports", tid + rid, "leader_input_share", enc_share),
+            helper_encrypted_input_share=HpkeCiphertext.decode(helper_blob),
+        )
+
+    def get_unaggregated_client_reports_for_task(
+        self, task_id: TaskId, limit: int = 5000
+    ) -> list[tuple[ReportId, Time]]:
+        """Atomically claim up to `limit` unaggregated reports
+        (UPDATE..RETURNING discipline, reference datastore.rs:1183)."""
+        rows = self._exec(
+            """UPDATE client_reports SET aggregation_started = 1
+               WHERE rowid IN (
+                   SELECT rowid FROM client_reports
+                   WHERE task_id = ? AND aggregation_started = 0
+                   ORDER BY client_timestamp LIMIT ?)
+               RETURNING report_id, client_timestamp""",
+            (bytes(task_id), limit),
+        ).fetchall()
+        return [(ReportId(r[0]), Time(r[1])) for r in rows]
+
+    def mark_report_unaggregated(self, task_id: TaskId, report_id: ReportId) -> None:
+        self._exec(
+            """UPDATE client_reports SET aggregation_started = 0
+               WHERE task_id = ? AND report_id = ?""",
+            (bytes(task_id), bytes(report_id)),
+        )
+
+    def scrub_client_report(self, task_id: TaskId, report_id: ReportId) -> None:
+        """Drop share payloads once aggregated (reference datastore.rs:1532)."""
+        cur = self._exec(
+            """UPDATE client_reports SET extensions = NULL, public_share = NULL,
+                 leader_input_share = NULL, helper_encrypted_input_share = NULL
+               WHERE task_id = ? AND report_id = ?""",
+            (bytes(task_id), bytes(report_id)),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such report")
+
+    def count_unaggregated_reports_in_interval(self, task_id: TaskId,
+                                               interval: Interval) -> int:
+        row = self._exec(
+            """SELECT COUNT(*) FROM client_reports
+               WHERE task_id = ? AND aggregation_started = 0
+                 AND client_timestamp >= ? AND client_timestamp < ?""",
+            (bytes(task_id), interval.start.seconds, interval.end().seconds),
+        ).fetchone()
+        return row[0]
+
+    # -- aggregation jobs -------------------------------------------------
+
+    def put_aggregation_job(self, job: m.AggregationJob) -> None:
+        try:
+            self._exec(
+                """INSERT INTO aggregation_jobs (task_id, aggregation_job_id,
+                     aggregation_param, batch_id, client_timestamp_interval_start,
+                     client_timestamp_interval_duration, state, step,
+                     last_request_hash, updated_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?)""",
+                (bytes(job.task_id), bytes(job.id), job.aggregation_parameter,
+                 bytes(job.partial_batch_identifier)
+                 if job.partial_batch_identifier else None,
+                 job.client_timestamp_interval.start.seconds,
+                 job.client_timestamp_interval.duration.seconds,
+                 job.state.value, job.step.value, job.last_request_hash, self._now()),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def get_aggregation_job(self, task_id: TaskId,
+                            job_id: AggregationJobId) -> m.AggregationJob | None:
+        row = self._exec(
+            """SELECT aggregation_param, batch_id, client_timestamp_interval_start,
+                      client_timestamp_interval_duration, state, step, last_request_hash
+               FROM aggregation_jobs WHERE task_id = ? AND aggregation_job_id = ?""",
+            (bytes(task_id), bytes(job_id)),
+        ).fetchone()
+        if row is None:
+            return None
+        param, batch_id, ts, dur, state, step, req_hash = row
+        return m.AggregationJob(
+            task_id=task_id, id=job_id, aggregation_parameter=param,
+            partial_batch_identifier=BatchId(batch_id) if batch_id else None,
+            client_timestamp_interval=Interval(Time(ts), Duration(dur)),
+            state=m.AggregationJobState(state), step=AggregationJobStep(step),
+            last_request_hash=req_hash,
+        )
+
+    def update_aggregation_job(self, job: m.AggregationJob) -> None:
+        cur = self._exec(
+            """UPDATE aggregation_jobs SET state = ?, step = ?, last_request_hash = ?,
+                 updated_at = ? WHERE task_id = ? AND aggregation_job_id = ?""",
+            (job.state.value, job.step.value, job.last_request_hash, self._now(),
+             bytes(job.task_id), bytes(job.id)),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such aggregation job")
+
+    def get_aggregation_jobs_for_task(self, task_id: TaskId) -> list[m.AggregationJob]:
+        rows = self._exec(
+            """SELECT aggregation_job_id, aggregation_param, batch_id,
+                      client_timestamp_interval_start,
+                      client_timestamp_interval_duration, state, step, last_request_hash
+               FROM aggregation_jobs WHERE task_id = ?""",
+            (bytes(task_id),),
+        ).fetchall()
+        return [
+            m.AggregationJob(
+                task_id=task_id, id=AggregationJobId(r[0]), aggregation_parameter=r[1],
+                partial_batch_identifier=BatchId(r[2]) if r[2] else None,
+                client_timestamp_interval=Interval(Time(r[3]), Duration(r[4])),
+                state=m.AggregationJobState(r[5]), step=AggregationJobStep(r[6]),
+                last_request_hash=r[7],
+            )
+            for r in rows
+        ]
+
+    def acquire_incomplete_aggregation_jobs(
+        self, lease_duration: Duration, limit: int
+    ) -> list[m.Lease]:
+        """Atomic lease claim (reference datastore.rs:1755)."""
+        now = self._now()
+        expiry = now + lease_duration.seconds
+        rows = self._exec(
+            """SELECT a.task_id, a.aggregation_job_id, t.query_type, t.vdaf
+               FROM aggregation_jobs a JOIN tasks t ON a.task_id = t.task_id
+               WHERE a.state = 'IN_PROGRESS' AND a.lease_expiry <= ?
+                 AND (t.task_expiration IS NULL OR t.task_expiration >= ?)
+               ORDER BY a.lease_expiry LIMIT ?""",
+            (now, now, limit),
+        ).fetchall()
+        leases = []
+        for tid, jid, qt_json, vdaf_json in rows:
+            token = os.urandom(m.LeaseToken.SIZE)
+            cur = self._exec(
+                """UPDATE aggregation_jobs
+                   SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1
+                   WHERE task_id = ? AND aggregation_job_id = ?
+                     AND state = 'IN_PROGRESS' AND lease_expiry <= ?""",
+                (expiry, token, tid, jid, now),
+            )
+            if cur.rowcount == 0:
+                continue  # raced: another process claimed it (SKIP LOCKED analog)
+            attempts = self._exec(
+                """SELECT lease_attempts FROM aggregation_jobs
+                   WHERE task_id = ? AND aggregation_job_id = ?""",
+                (tid, jid),
+            ).fetchone()[0]
+            leases.append(m.Lease(
+                leased=m.AcquiredAggregationJob(
+                    TaskId(tid), AggregationJobId(jid),
+                    1 if json.loads(qt_json) == "TimeInterval" else 2, vdaf_json),
+                lease_expiry=Time(expiry), lease_token=token, lease_attempts=attempts,
+            ))
+        return leases
+
+    def release_aggregation_job(self, lease: m.Lease) -> None:
+        job = lease.leased
+        cur = self._exec(
+            """UPDATE aggregation_jobs SET lease_expiry = 0, lease_token = NULL
+               WHERE task_id = ? AND aggregation_job_id = ? AND lease_token = ?""",
+            (bytes(job.task_id), bytes(job.aggregation_job_id), lease.lease_token),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("lease not held")
+
+    # -- report aggregations ----------------------------------------------
+
+    def put_report_aggregation(self, ra: m.ReportAggregation) -> None:
+        s = ra.state
+        tid = bytes(ra.task_id)
+        rid = bytes(ra.report_id)
+        enc_leader_share = None
+        if s.leader_input_share is not None:
+            enc_leader_share = self.crypter.encrypt(
+                "report_aggregations", tid + rid, "leader_input_share",
+                s.leader_input_share)
+        try:
+            self._exec(
+                """INSERT INTO report_aggregations (task_id, aggregation_job_id,
+                     report_id, client_timestamp, ord, state, public_share,
+                     leader_extensions, leader_input_share,
+                     helper_encrypted_input_share, leader_prep_transition,
+                     helper_prep_state, prepare_error, last_prep_resp)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (tid, bytes(ra.aggregation_job_id), rid, ra.time.seconds, ra.ord,
+                 s.kind.value, s.public_share,
+                 b"".join(e.encode() for e in s.leader_extensions) or None,
+                 enc_leader_share,
+                 s.helper_encrypted_input_share.encode()
+                 if s.helper_encrypted_input_share else None,
+                 s.leader_prep_transition, s.helper_prep_state,
+                 int(s.prepare_error) if s.prepare_error is not None else None,
+                 ra.last_prep_resp.encode() if ra.last_prep_resp else None),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def update_report_aggregation(self, ra: m.ReportAggregation) -> None:
+        s = ra.state
+        tid = bytes(ra.task_id)
+        rid = bytes(ra.report_id)
+        enc_leader_share = None
+        if s.leader_input_share is not None:
+            enc_leader_share = self.crypter.encrypt(
+                "report_aggregations", tid + rid, "leader_input_share",
+                s.leader_input_share)
+        cur = self._exec(
+            """UPDATE report_aggregations SET state = ?, public_share = ?,
+                 leader_extensions = ?, leader_input_share = ?,
+                 helper_encrypted_input_share = ?, leader_prep_transition = ?,
+                 helper_prep_state = ?, prepare_error = ?, last_prep_resp = ?
+               WHERE task_id = ? AND aggregation_job_id = ? AND ord = ?""",
+            (s.kind.value, s.public_share,
+             b"".join(e.encode() for e in s.leader_extensions) or None,
+             enc_leader_share,
+             s.helper_encrypted_input_share.encode()
+             if s.helper_encrypted_input_share else None,
+             s.leader_prep_transition, s.helper_prep_state,
+             int(s.prepare_error) if s.prepare_error is not None else None,
+             ra.last_prep_resp.encode() if ra.last_prep_resp else None,
+             tid, bytes(ra.aggregation_job_id), ra.ord),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such report aggregation")
+
+    def get_report_aggregations_for_aggregation_job(
+        self, task_id: TaskId, job_id: AggregationJobId
+    ) -> list[m.ReportAggregation]:
+        rows = self._exec(
+            """SELECT report_id, client_timestamp, ord, state, public_share,
+                      leader_extensions, leader_input_share,
+                      helper_encrypted_input_share, leader_prep_transition,
+                      helper_prep_state, prepare_error, last_prep_resp
+               FROM report_aggregations
+               WHERE task_id = ? AND aggregation_job_id = ? ORDER BY ord""",
+            (bytes(task_id), bytes(job_id)),
+        ).fetchall()
+        out = []
+        for r in rows:
+            (rid, ts, ord_, state, public_share, ext_blob, enc_share, helper_blob,
+             transition, prep_state, prep_err, last_resp) = r
+            extensions = []
+            if ext_blob:
+                from janus_tpu.messages.codec import Cursor
+
+                cur = Cursor(ext_blob)
+                while cur.remaining():
+                    extensions.append(Extension.decode_from(cur))
+            leader_share = None
+            if enc_share is not None:
+                leader_share = self.crypter.decrypt(
+                    "report_aggregations", bytes(task_id) + rid, "leader_input_share",
+                    enc_share)
+            out.append(m.ReportAggregation(
+                task_id=task_id, aggregation_job_id=job_id, report_id=ReportId(rid),
+                time=Time(ts), ord=ord_,
+                state=m.ReportAggregationState(
+                    kind=m.ReportAggregationStateKind(state),
+                    public_share=public_share,
+                    leader_extensions=tuple(extensions),
+                    leader_input_share=leader_share,
+                    helper_encrypted_input_share=HpkeCiphertext.decode(helper_blob)
+                    if helper_blob else None,
+                    leader_prep_transition=transition,
+                    helper_prep_state=prep_state,
+                    prepare_error=PrepareError(prep_err) if prep_err is not None else None,
+                ),
+                last_prep_resp=PrepareResp.decode(last_resp) if last_resp else None,
+            ))
+        return out
+
+    def check_report_replayed(self, task_id: TaskId, report_id: ReportId,
+                              exclude_job: AggregationJobId) -> bool:
+        """Has this report id been aggregated under a different job?
+        (reference replay check, aggregator.rs:2100-2136)"""
+        return self._exec(
+            """SELECT 1 FROM report_aggregations
+               WHERE task_id = ? AND report_id = ? AND aggregation_job_id != ?
+               LIMIT 1""",
+            (bytes(task_id), bytes(report_id), bytes(exclude_job)),
+        ).fetchone() is not None
+
+    # -- batch aggregations (sharded accumulators) ------------------------
+
+    def put_batch_aggregation(self, ba: m.BatchAggregation) -> None:
+        try:
+            self._exec(
+                """INSERT INTO batch_aggregations (task_id, batch_identifier,
+                     aggregation_param, ord, state, aggregate_share, report_count,
+                     client_timestamp_interval_start,
+                     client_timestamp_interval_duration, checksum,
+                     aggregation_jobs_created, aggregation_jobs_terminated)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (bytes(ba.task_id), m.encode_batch_identifier(ba.batch_identifier),
+                 ba.aggregation_parameter, ba.ord, ba.state.value, ba.aggregate_share,
+                 ba.report_count, ba.client_timestamp_interval.start.seconds,
+                 ba.client_timestamp_interval.duration.seconds, bytes(ba.checksum),
+                 ba.aggregation_jobs_created, ba.aggregation_jobs_terminated),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def update_batch_aggregation(self, ba: m.BatchAggregation) -> None:
+        cur = self._exec(
+            """UPDATE batch_aggregations SET state = ?, aggregate_share = ?,
+                 report_count = ?, client_timestamp_interval_start = ?,
+                 client_timestamp_interval_duration = ?, checksum = ?,
+                 aggregation_jobs_created = ?, aggregation_jobs_terminated = ?
+               WHERE task_id = ? AND batch_identifier = ? AND aggregation_param = ?
+                 AND ord = ?""",
+            (ba.state.value, ba.aggregate_share, ba.report_count,
+             ba.client_timestamp_interval.start.seconds,
+             ba.client_timestamp_interval.duration.seconds, bytes(ba.checksum),
+             ba.aggregation_jobs_created, ba.aggregation_jobs_terminated,
+             bytes(ba.task_id), m.encode_batch_identifier(ba.batch_identifier),
+             ba.aggregation_parameter, ba.ord),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such batch aggregation shard")
+
+    def get_batch_aggregations(self, task_id: TaskId, batch_identifier,
+                               aggregation_parameter: bytes) -> list[m.BatchAggregation]:
+        rows = self._exec(
+            """SELECT ord, state, aggregate_share, report_count,
+                      client_timestamp_interval_start,
+                      client_timestamp_interval_duration, checksum,
+                      aggregation_jobs_created, aggregation_jobs_terminated
+               FROM batch_aggregations
+               WHERE task_id = ? AND batch_identifier = ? AND aggregation_param = ?
+               ORDER BY ord""",
+            (bytes(task_id), m.encode_batch_identifier(batch_identifier),
+             aggregation_parameter),
+        ).fetchall()
+        return [
+            m.BatchAggregation(
+                task_id=task_id, batch_identifier=batch_identifier,
+                aggregation_parameter=aggregation_parameter, ord=r[0],
+                state=m.BatchAggregationState(r[1]), aggregate_share=r[2],
+                report_count=r[3],
+                client_timestamp_interval=Interval(Time(r[4]), Duration(r[5])),
+                checksum=ReportIdChecksum(r[6]),
+                aggregation_jobs_created=r[7], aggregation_jobs_terminated=r[8],
+            )
+            for r in rows
+        ]
+
+    def get_batch_aggregation_identifiers_for_task(self, task_id: TaskId) -> list:
+        rows = self._exec(
+            "SELECT DISTINCT batch_identifier FROM batch_aggregations WHERE task_id = ?",
+            (bytes(task_id),),
+        ).fetchall()
+        return [m.decode_batch_identifier(r[0]) for r in rows]
+
+    # -- collection jobs --------------------------------------------------
+
+    def put_collection_job(self, job: m.CollectionJob) -> None:
+        tid = bytes(job.task_id)
+        enc_share = None
+        if job.leader_aggregate_share is not None:
+            enc_share = self.crypter.encrypt(
+                "collection_jobs", tid + bytes(job.id), "leader_aggregate_share",
+                job.leader_aggregate_share)
+        try:
+            self._exec(
+                """INSERT INTO collection_jobs (task_id, collection_job_id, query,
+                     aggregation_param, batch_identifier, state, report_count,
+                     client_timestamp_interval_start,
+                     client_timestamp_interval_duration, leader_aggregate_share,
+                     helper_encrypted_aggregate_share, updated_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (tid, bytes(job.id), job.query.encode(), job.aggregation_parameter,
+                 m.encode_batch_identifier(job.batch_identifier), job.state.value,
+                 job.report_count,
+                 job.client_timestamp_interval.start.seconds
+                 if job.client_timestamp_interval else None,
+                 job.client_timestamp_interval.duration.seconds
+                 if job.client_timestamp_interval else None,
+                 enc_share,
+                 job.helper_encrypted_aggregate_share.encode()
+                 if job.helper_encrypted_aggregate_share else None,
+                 self._now()),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def get_collection_job(self, task_id: TaskId,
+                           job_id: CollectionJobId) -> m.CollectionJob | None:
+        tid = bytes(task_id)
+        row = self._exec(
+            """SELECT query, aggregation_param, batch_identifier, state, report_count,
+                      client_timestamp_interval_start,
+                      client_timestamp_interval_duration, leader_aggregate_share,
+                      helper_encrypted_aggregate_share
+               FROM collection_jobs WHERE task_id = ? AND collection_job_id = ?""",
+            (tid, bytes(job_id)),
+        ).fetchone()
+        if row is None:
+            return None
+        (query_blob, param, ident, state, count, ts, dur, enc_share, helper_blob) = row
+        share = None
+        if enc_share is not None:
+            share = self.crypter.decrypt(
+                "collection_jobs", tid + bytes(job_id), "leader_aggregate_share",
+                enc_share)
+        return m.CollectionJob(
+            task_id=task_id, id=job_id, query=Query.decode(query_blob),
+            aggregation_parameter=param,
+            batch_identifier=m.decode_batch_identifier(ident),
+            state=m.CollectionJobState(state), report_count=count,
+            client_timestamp_interval=Interval(Time(ts), Duration(dur))
+            if ts is not None else None,
+            leader_aggregate_share=share,
+            helper_encrypted_aggregate_share=HpkeCiphertext.decode(helper_blob)
+            if helper_blob else None,
+        )
+
+    def update_collection_job(self, job: m.CollectionJob) -> None:
+        tid = bytes(job.task_id)
+        enc_share = None
+        if job.leader_aggregate_share is not None:
+            enc_share = self.crypter.encrypt(
+                "collection_jobs", tid + bytes(job.id), "leader_aggregate_share",
+                job.leader_aggregate_share)
+        cur = self._exec(
+            """UPDATE collection_jobs SET state = ?, report_count = ?,
+                 client_timestamp_interval_start = ?,
+                 client_timestamp_interval_duration = ?, leader_aggregate_share = ?,
+                 helper_encrypted_aggregate_share = ?, updated_at = ?
+               WHERE task_id = ? AND collection_job_id = ?""",
+            (job.state.value, job.report_count,
+             job.client_timestamp_interval.start.seconds
+             if job.client_timestamp_interval else None,
+             job.client_timestamp_interval.duration.seconds
+             if job.client_timestamp_interval else None,
+             enc_share,
+             job.helper_encrypted_aggregate_share.encode()
+             if job.helper_encrypted_aggregate_share else None,
+             self._now(), tid, bytes(job.id)),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such collection job")
+
+    def get_collection_jobs_for_task(self, task_id: TaskId) -> list[m.CollectionJob]:
+        rows = self._exec(
+            "SELECT collection_job_id FROM collection_jobs WHERE task_id = ?",
+            (bytes(task_id),),
+        ).fetchall()
+        return [self.get_collection_job(task_id, CollectionJobId(r[0])) for r in rows]
+
+    def acquire_incomplete_collection_jobs(
+        self, lease_duration: Duration, limit: int
+    ) -> list[m.Lease]:
+        now = self._now()
+        expiry = now + lease_duration.seconds
+        rows = self._exec(
+            """SELECT c.task_id, c.collection_job_id, t.query_type, t.vdaf,
+                      c.step_attempts
+               FROM collection_jobs c JOIN tasks t ON c.task_id = t.task_id
+               WHERE c.state = 'START' AND c.lease_expiry <= ?
+               ORDER BY c.lease_expiry LIMIT ?""",
+            (now, limit),
+        ).fetchall()
+        leases = []
+        for tid, jid, qt_json, vdaf_json, step_attempts in rows:
+            token = os.urandom(m.LeaseToken.SIZE)
+            cur = self._exec(
+                """UPDATE collection_jobs
+                   SET lease_expiry = ?, lease_token = ?,
+                       lease_attempts = lease_attempts + 1
+                   WHERE task_id = ? AND collection_job_id = ?
+                     AND state = 'START' AND lease_expiry <= ?""",
+                (expiry, token, tid, jid, now),
+            )
+            if cur.rowcount == 0:
+                continue
+            attempts = self._exec(
+                """SELECT lease_attempts FROM collection_jobs
+                   WHERE task_id = ? AND collection_job_id = ?""",
+                (tid, jid),
+            ).fetchone()[0]
+            leases.append(m.Lease(
+                leased=m.AcquiredCollectionJob(
+                    TaskId(tid), CollectionJobId(jid),
+                    1 if json.loads(qt_json) == "TimeInterval" else 2, vdaf_json,
+                    step_attempts),
+                lease_expiry=Time(expiry), lease_token=token, lease_attempts=attempts,
+            ))
+        return leases
+
+    def release_collection_job(self, lease: m.Lease,
+                               reacquire_delay: Duration | None = None) -> None:
+        job = lease.leased
+        new_expiry = 0
+        if reacquire_delay is not None:
+            new_expiry = self._now() + reacquire_delay.seconds
+        cur = self._exec(
+            """UPDATE collection_jobs SET lease_expiry = ?, lease_token = NULL,
+                 step_attempts = step_attempts + 1
+               WHERE task_id = ? AND collection_job_id = ? AND lease_token = ?""",
+            (new_expiry, bytes(job.task_id), bytes(job.collection_job_id),
+             lease.lease_token),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("lease not held")
+
+    # -- aggregate share jobs (helper cache) ------------------------------
+
+    def put_aggregate_share_job(self, job: m.AggregateShareJob) -> None:
+        tid = bytes(job.task_id)
+        ident = m.encode_batch_identifier(job.batch_identifier)
+        try:
+            self._exec(
+                """INSERT INTO aggregate_share_jobs (task_id, batch_identifier,
+                     aggregation_param, helper_aggregate_share, report_count, checksum)
+                   VALUES (?,?,?,?,?,?)""",
+                (tid, ident, job.aggregation_parameter,
+                 self.crypter.encrypt("aggregate_share_jobs", tid + ident,
+                                      "helper_aggregate_share",
+                                      job.helper_aggregate_share),
+                 job.report_count, bytes(job.checksum)),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def get_aggregate_share_job(self, task_id: TaskId, batch_identifier,
+                                aggregation_parameter: bytes) -> m.AggregateShareJob | None:
+        tid = bytes(task_id)
+        ident = m.encode_batch_identifier(batch_identifier)
+        row = self._exec(
+            """SELECT helper_aggregate_share, report_count, checksum
+               FROM aggregate_share_jobs
+               WHERE task_id = ? AND batch_identifier = ? AND aggregation_param = ?""",
+            (tid, ident, aggregation_parameter),
+        ).fetchone()
+        if row is None:
+            return None
+        return m.AggregateShareJob(
+            task_id=task_id, batch_identifier=batch_identifier,
+            aggregation_parameter=aggregation_parameter,
+            helper_aggregate_share=self.crypter.decrypt(
+                "aggregate_share_jobs", tid + ident, "helper_aggregate_share", row[0]),
+            report_count=row[1], checksum=ReportIdChecksum(row[2]),
+        )
+
+    # -- query count enforcement ------------------------------------------
+
+    def put_batch_query(self, task_id: TaskId, batch_identifier,
+                        aggregation_parameter: bytes) -> bool:
+        """Record that a batch was queried; returns False if already recorded
+        (idempotent re-query of the same batch/param is allowed)."""
+        try:
+            self._exec(
+                """INSERT INTO batch_queries (task_id, batch_identifier,
+                     aggregation_param) VALUES (?,?,?)""",
+                (bytes(task_id), m.encode_batch_identifier(batch_identifier),
+                 aggregation_parameter),
+            )
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+    def count_batch_queries(self, task_id: TaskId, batch_identifier) -> int:
+        return self._exec(
+            """SELECT COUNT(*) FROM batch_queries
+               WHERE task_id = ? AND batch_identifier = ?""",
+            (bytes(task_id), m.encode_batch_identifier(batch_identifier)),
+        ).fetchone()[0]
+
+    def get_queried_batch_intervals_overlapping(
+        self, task_id: TaskId, interval: Interval
+    ) -> list[Interval]:
+        """Batch-overlap enforcement for time-interval queries."""
+        rows = self._exec(
+            "SELECT DISTINCT batch_identifier FROM batch_queries WHERE task_id = ?",
+            (bytes(task_id),),
+        ).fetchall()
+        out = []
+        for (blob,) in rows:
+            ident = m.decode_batch_identifier(blob)
+            if isinstance(ident, Interval) and ident.overlaps(interval):
+                out.append(ident)
+        return out
+
+    # -- outstanding batches (fixed-size) ---------------------------------
+
+    def put_outstanding_batch(self, batch: m.OutstandingBatch) -> None:
+        try:
+            self._exec(
+                """INSERT INTO outstanding_batches (task_id, batch_id,
+                     time_bucket_start) VALUES (?,?,?)""",
+                (bytes(batch.task_id), bytes(batch.id),
+                 batch.time_bucket_start.seconds if batch.time_bucket_start else None),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def get_outstanding_batches(self, task_id: TaskId,
+                                time_bucket_start: Time | None = None
+                                ) -> list[tuple[m.OutstandingBatch, int]]:
+        """-> [(batch, filled_count)]."""
+        if time_bucket_start is None:
+            rows = self._exec(
+                """SELECT batch_id, time_bucket_start, filled FROM outstanding_batches
+                   WHERE task_id = ?""",
+                (bytes(task_id),),
+            ).fetchall()
+        else:
+            rows = self._exec(
+                """SELECT batch_id, time_bucket_start, filled FROM outstanding_batches
+                   WHERE task_id = ? AND time_bucket_start = ?""",
+                (bytes(task_id), time_bucket_start.seconds),
+            ).fetchall()
+        return [
+            (m.OutstandingBatch(task_id, BatchId(r[0]),
+                                Time(r[1]) if r[1] is not None else None), r[2])
+            for r in rows
+        ]
+
+    def add_to_outstanding_batch(self, task_id: TaskId, batch_id: BatchId,
+                                 count: int) -> None:
+        self._exec(
+            """UPDATE outstanding_batches SET filled = filled + ?
+               WHERE task_id = ? AND batch_id = ?""",
+            (count, bytes(task_id), bytes(batch_id)),
+        )
+
+    def delete_outstanding_batch(self, task_id: TaskId, batch_id: BatchId) -> None:
+        self._exec(
+            "DELETE FROM outstanding_batches WHERE task_id = ? AND batch_id = ?",
+            (bytes(task_id), bytes(batch_id)),
+        )
+
+    # -- global HPKE keys -------------------------------------------------
+
+    def put_global_hpke_keypair(self, keypair: HpkeKeypair) -> None:
+        cfg_id = keypair.config.id.value
+        try:
+            self._exec(
+                """INSERT INTO global_hpke_keys (config_id, config, private_key,
+                     state, last_state_change_at) VALUES (?,?,?,?,?)""",
+                (cfg_id, keypair.config.encode(),
+                 self.crypter.encrypt("global_hpke_keys", bytes([cfg_id]),
+                                      "private_key", keypair.private_key),
+                 m.HpkeKeyState.PENDING.value, self._now()),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def get_global_hpke_keypairs(self) -> list[m.GlobalHpkeKeypair]:
+        rows = self._exec(
+            """SELECT config_id, config, private_key, state, last_state_change_at
+               FROM global_hpke_keys"""
+        ).fetchall()
+        return [
+            m.GlobalHpkeKeypair(
+                keypair=HpkeKeypair(
+                    HpkeConfig.decode(r[1]),
+                    self.crypter.decrypt("global_hpke_keys", bytes([r[0]]),
+                                         "private_key", r[2]),
+                ),
+                state=m.HpkeKeyState(r[3]),
+                last_state_change_at=Time(r[4]),
+            )
+            for r in rows
+        ]
+
+    def set_global_hpke_keypair_state(self, config_id: int,
+                                      state: m.HpkeKeyState) -> None:
+        cur = self._exec(
+            """UPDATE global_hpke_keys SET state = ?, last_state_change_at = ?
+               WHERE config_id = ?""",
+            (state.value, self._now(), config_id),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such global HPKE key")
+
+    def delete_global_hpke_keypair(self, config_id: int) -> None:
+        cur = self._exec("DELETE FROM global_hpke_keys WHERE config_id = ?",
+                         (config_id,))
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such global HPKE key")
+
+    # -- upload counters --------------------------------------------------
+
+    def increment_task_upload_counter(self, task_id: TaskId, ord_: int,
+                                      counter: m.TaskUploadCounter) -> None:
+        self._exec(
+            """INSERT INTO task_upload_counters (task_id, ord, interval_collected,
+                 report_decode_failure, report_decrypt_failure, report_expired,
+                 report_outdated_key, report_success, report_too_early, task_expired)
+               VALUES (?,?,?,?,?,?,?,?,?,?)
+               ON CONFLICT (task_id, ord) DO UPDATE SET
+                 interval_collected = interval_collected + excluded.interval_collected,
+                 report_decode_failure = report_decode_failure + excluded.report_decode_failure,
+                 report_decrypt_failure = report_decrypt_failure + excluded.report_decrypt_failure,
+                 report_expired = report_expired + excluded.report_expired,
+                 report_outdated_key = report_outdated_key + excluded.report_outdated_key,
+                 report_success = report_success + excluded.report_success,
+                 report_too_early = report_too_early + excluded.report_too_early,
+                 task_expired = task_expired + excluded.task_expired""",
+            (bytes(task_id), ord_, counter.interval_collected,
+             counter.report_decode_failure, counter.report_decrypt_failure,
+             counter.report_expired, counter.report_outdated_key,
+             counter.report_success, counter.report_too_early, counter.task_expired),
+        )
+
+    def get_task_upload_counter(self, task_id: TaskId) -> m.TaskUploadCounter:
+        row = self._exec(
+            """SELECT COALESCE(SUM(interval_collected),0),
+                      COALESCE(SUM(report_decode_failure),0),
+                      COALESCE(SUM(report_decrypt_failure),0),
+                      COALESCE(SUM(report_expired),0),
+                      COALESCE(SUM(report_outdated_key),0),
+                      COALESCE(SUM(report_success),0),
+                      COALESCE(SUM(report_too_early),0),
+                      COALESCE(SUM(task_expired),0)
+               FROM task_upload_counters WHERE task_id = ?""",
+            (bytes(task_id),),
+        ).fetchone()
+        return m.TaskUploadCounter(*row)
+
+    # -- garbage collection (reference garbage_collector.rs) --------------
+
+    def delete_expired_client_reports(self, task_id: TaskId, expiry_age: Duration,
+                                      limit: int = 5000) -> int:
+        cutoff = self._now() - expiry_age.seconds
+        cur = self._exec(
+            """DELETE FROM client_reports WHERE rowid IN (
+                 SELECT rowid FROM client_reports
+                 WHERE task_id = ? AND client_timestamp < ? LIMIT ?)""",
+            (bytes(task_id), cutoff, limit),
+        )
+        return cur.rowcount
+
+    def delete_expired_aggregation_artifacts(self, task_id: TaskId,
+                                             expiry_age: Duration,
+                                             limit: int = 5000) -> int:
+        cutoff = self._now() - expiry_age.seconds
+        cur = self._exec(
+            """DELETE FROM aggregation_jobs WHERE rowid IN (
+                 SELECT rowid FROM aggregation_jobs
+                 WHERE task_id = ?
+                   AND client_timestamp_interval_start
+                       + client_timestamp_interval_duration < ?
+                 LIMIT ?)""",
+            (bytes(task_id), cutoff, limit),
+        )
+        return cur.rowcount
+
+    def delete_expired_collection_artifacts(self, task_id: TaskId,
+                                            expiry_age: Duration,
+                                            limit: int = 5000) -> int:
+        cutoff = self._now() - expiry_age.seconds
+        n = 0
+        for table, start_col, dur_col in [
+            ("collection_jobs", "client_timestamp_interval_start",
+             "client_timestamp_interval_duration"),
+            ("batch_aggregations", "client_timestamp_interval_start",
+             "client_timestamp_interval_duration"),
+        ]:
+            cur = self._exec(
+                f"""DELETE FROM {table} WHERE rowid IN (
+                     SELECT rowid FROM {table}
+                     WHERE task_id = ? AND {start_col} IS NOT NULL
+                       AND {start_col} + {dur_col} < ? LIMIT ?)""",
+                (bytes(task_id), cutoff, limit),
+            )
+            n += cur.rowcount
+        return n
+
+
+def ephemeral_datastore(clock: Clock | None = None) -> Datastore:
+    """Test fixture: fresh in-memory datastore with schema applied and a
+    random Crypter (the analog of the reference's ephemeral_datastore(),
+    datastore/test_util.rs)."""
+    from janus_tpu.core.time import MockClock
+
+    ds = Datastore(SqliteBackend(), Crypter.generate(), clock or MockClock())
+    ds.put_schema()
+    ds.check_schema_version()
+    return ds

@@ -1,0 +1,338 @@
+"""Datastore domain models (reference aggregator_core/src/datastore/models.rs).
+
+Protocol state that round-trips through the store: aggregation jobs, the
+per-report state machine, batch accumulators, collection jobs, leases.
+VDAF-specific payloads (prep states, transitions, output shares) are opaque
+bytes here, encoded/decoded by the VDAF layer at the edges — exactly the
+reference's bytea-column discipline (models.rs:902, SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import struct
+from dataclasses import dataclass, replace
+
+from janus_tpu.messages import (
+    AggregationJobId,
+    AggregationJobStep,
+    BatchId,
+    CollectionJobId,
+    Extension,
+    HpkeCiphertext,
+    Interval,
+    PrepareError,
+    PrepareResp,
+    Query,
+    ReportId,
+    ReportIdChecksum,
+    ReportMetadata,
+    TaskId,
+    Time,
+)
+
+
+class AggregationJobState(str, enum.Enum):
+    IN_PROGRESS = "IN_PROGRESS"
+    FINISHED = "FINISHED"
+    ABANDONED = "ABANDONED"
+    DELETED = "DELETED"
+
+
+@dataclass(frozen=True)
+class AggregationJob:
+    """reference models.rs:358."""
+
+    task_id: TaskId
+    id: AggregationJobId
+    aggregation_parameter: bytes
+    partial_batch_identifier: BatchId | None  # fixed-size only
+    client_timestamp_interval: Interval
+    state: AggregationJobState
+    step: AggregationJobStep
+    last_request_hash: bytes | None = None
+
+    def with_state(self, state: AggregationJobState) -> "AggregationJob":
+        return replace(self, state=state)
+
+    def with_step(self, step: AggregationJobStep) -> "AggregationJob":
+        return replace(self, step=step)
+
+    def with_last_request_hash(self, h: bytes) -> "AggregationJob":
+        return replace(self, last_request_hash=h)
+
+
+class ReportAggregationStateKind(str, enum.Enum):
+    START_LEADER = "START_LEADER"
+    WAITING_LEADER = "WAITING_LEADER"
+    WAITING_HELPER = "WAITING_HELPER"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+
+
+@dataclass(frozen=True)
+class ReportAggregationState:
+    """The per-report state machine (reference models.rs:855).
+
+    kind START_LEADER carries the unaggregated report content;
+    WAITING_LEADER carries the encoded ping-pong transition;
+    WAITING_HELPER carries the encoded prep state; FAILED carries the error.
+    """
+
+    kind: ReportAggregationStateKind
+    # START_LEADER
+    public_share: bytes | None = None
+    leader_extensions: tuple[Extension, ...] = ()
+    leader_input_share: bytes | None = None
+    helper_encrypted_input_share: HpkeCiphertext | None = None
+    # WAITING_LEADER
+    leader_prep_transition: bytes | None = None
+    # WAITING_HELPER
+    helper_prep_state: bytes | None = None
+    # FAILED
+    prepare_error: PrepareError | None = None
+
+    @classmethod
+    def start_leader(cls, public_share, leader_extensions, leader_input_share,
+                     helper_encrypted_input_share) -> "ReportAggregationState":
+        return cls(ReportAggregationStateKind.START_LEADER, public_share=public_share,
+                   leader_extensions=tuple(leader_extensions),
+                   leader_input_share=leader_input_share,
+                   helper_encrypted_input_share=helper_encrypted_input_share)
+
+    @classmethod
+    def waiting_leader(cls, transition: bytes) -> "ReportAggregationState":
+        return cls(ReportAggregationStateKind.WAITING_LEADER,
+                   leader_prep_transition=transition)
+
+    @classmethod
+    def waiting_helper(cls, prep_state: bytes) -> "ReportAggregationState":
+        return cls(ReportAggregationStateKind.WAITING_HELPER, helper_prep_state=prep_state)
+
+    @classmethod
+    def finished(cls) -> "ReportAggregationState":
+        return cls(ReportAggregationStateKind.FINISHED)
+
+    @classmethod
+    def failed(cls, error: PrepareError) -> "ReportAggregationState":
+        return cls(ReportAggregationStateKind.FAILED, prepare_error=error)
+
+
+@dataclass(frozen=True)
+class ReportAggregation:
+    """reference models.rs:726."""
+
+    task_id: TaskId
+    aggregation_job_id: AggregationJobId
+    report_id: ReportId
+    time: Time
+    ord: int
+    state: ReportAggregationState
+    last_prep_resp: PrepareResp | None = None
+
+    def with_state(self, state: ReportAggregationState) -> "ReportAggregation":
+        return replace(self, state=state)
+
+    def with_last_prep_resp(self, resp: PrepareResp | None) -> "ReportAggregation":
+        return replace(self, last_prep_resp=resp)
+
+
+class BatchAggregationState(str, enum.Enum):
+    AGGREGATING = "AGGREGATING"
+    COLLECTED = "COLLECTED"
+    SCRUBBED = "SCRUBBED"
+
+
+@dataclass(frozen=True)
+class BatchAggregation:
+    """One shard of a batch accumulator (reference models.rs:1152; sharded by
+    `ord` to spread write contention, SURVEY.md §P4)."""
+
+    task_id: TaskId
+    batch_identifier: object  # Interval | BatchId
+    aggregation_parameter: bytes
+    ord: int
+    state: BatchAggregationState
+    aggregate_share: bytes | None  # encoded field vector (or None if empty)
+    report_count: int
+    client_timestamp_interval: Interval
+    checksum: ReportIdChecksum
+    aggregation_jobs_created: int
+    aggregation_jobs_terminated: int
+
+    def merged_with(self, other: "BatchAggregation", merge_shares) -> "BatchAggregation":
+        """Combine two shards (merge_shares: (bytes|None, bytes|None) -> bytes|None)."""
+        interval = self.client_timestamp_interval
+        if other.report_count or other.aggregate_share is not None:
+            if self.report_count or self.aggregate_share is not None:
+                interval = Interval.spanning(interval, other.client_timestamp_interval)
+            else:
+                interval = other.client_timestamp_interval
+        return replace(
+            self,
+            aggregate_share=merge_shares(self.aggregate_share, other.aggregate_share),
+            report_count=self.report_count + other.report_count,
+            client_timestamp_interval=interval,
+            checksum=self.checksum.combined(other.checksum),
+            aggregation_jobs_created=self.aggregation_jobs_created
+            + other.aggregation_jobs_created,
+            aggregation_jobs_terminated=self.aggregation_jobs_terminated
+            + other.aggregation_jobs_terminated,
+        )
+
+
+class CollectionJobState(str, enum.Enum):
+    START = "START"
+    FINISHED = "FINISHED"
+    ABANDONED = "ABANDONED"
+    DELETED = "DELETED"
+
+
+@dataclass(frozen=True)
+class CollectionJob:
+    """reference models.rs:1608."""
+
+    task_id: TaskId
+    id: CollectionJobId
+    query: Query
+    aggregation_parameter: bytes
+    batch_identifier: object  # Interval | BatchId
+    state: CollectionJobState
+    report_count: int | None = None
+    client_timestamp_interval: Interval | None = None
+    leader_aggregate_share: bytes | None = None
+    helper_encrypted_aggregate_share: HpkeCiphertext | None = None
+
+    def with_state(self, state: CollectionJobState) -> "CollectionJob":
+        return replace(self, state=state)
+
+
+@dataclass(frozen=True)
+class AggregateShareJob:
+    """Helper-side cached aggregate share (reference models.rs:1840)."""
+
+    task_id: TaskId
+    batch_identifier: object
+    aggregation_parameter: bytes
+    helper_aggregate_share: bytes
+    report_count: int
+    checksum: ReportIdChecksum
+
+
+@dataclass(frozen=True)
+class OutstandingBatch:
+    """A fixed-size batch being filled (reference models.rs:1965)."""
+
+    task_id: TaskId
+    id: BatchId
+    time_bucket_start: Time | None = None
+
+
+class LeaseToken:
+    SIZE = 16
+
+    def __init__(self, data: bytes | None = None):
+        self.data = data if data is not None else os.urandom(self.SIZE)
+
+    def __eq__(self, other):
+        return isinstance(other, LeaseToken) and self.data == other.data
+
+    def __hash__(self):
+        return hash(self.data)
+
+
+@dataclass(frozen=True)
+class Lease:
+    """A leased job (reference models.rs:574): the leased object plus lease
+    metadata; release/update must present the same token."""
+
+    leased: object
+    lease_expiry: Time
+    lease_token: bytes
+    lease_attempts: int
+
+
+@dataclass(frozen=True)
+class AcquiredAggregationJob:
+    task_id: TaskId
+    aggregation_job_id: AggregationJobId
+    query_type_code: int
+    vdaf_json: str
+
+
+@dataclass(frozen=True)
+class AcquiredCollectionJob:
+    task_id: TaskId
+    collection_job_id: CollectionJobId
+    query_type_code: int
+    vdaf_json: str
+    step_attempts: int
+
+
+@dataclass(frozen=True)
+class TaskUploadCounter:
+    """Sharded upload metrics (reference models.rs:2189, schema :147)."""
+
+    interval_collected: int = 0
+    report_decode_failure: int = 0
+    report_decrypt_failure: int = 0
+    report_expired: int = 0
+    report_outdated_key: int = 0
+    report_success: int = 0
+    report_too_early: int = 0
+    task_expired: int = 0
+
+    def plus(self, **kwargs) -> "TaskUploadCounter":
+        vals = {f: getattr(self, f) + kwargs.get(f, 0) for f in self.__dataclass_fields__}
+        return TaskUploadCounter(**vals)
+
+
+class HpkeKeyState(str, enum.Enum):
+    PENDING = "PENDING"
+    ACTIVE = "ACTIVE"
+    EXPIRED = "EXPIRED"
+
+
+@dataclass(frozen=True)
+class GlobalHpkeKeypair:
+    keypair: object  # core.hpke.HpkeKeypair
+    state: HpkeKeyState
+    last_state_change_at: Time
+
+
+# ---------------------------------------------------------------------------
+# batch identifier codecs (Interval for time-interval, BatchId for fixed-size)
+# ---------------------------------------------------------------------------
+
+
+def encode_batch_identifier(ident) -> bytes:
+    if isinstance(ident, Interval):
+        return struct.pack(">BQQ", 1, ident.start.seconds, ident.duration.seconds)
+    if isinstance(ident, BatchId):
+        return b"\x02" + bytes(ident)
+    raise TypeError(f"bad batch identifier {ident!r}")
+
+
+def decode_batch_identifier(data: bytes):
+    if data[0] == 1:
+        _, start, duration = struct.unpack(">BQQ", data)
+        from janus_tpu.messages import Duration
+
+        return Interval(Time(start), Duration(duration))
+    if data[0] == 2:
+        return BatchId(data[1:])
+    raise ValueError("bad batch identifier encoding")
+
+
+@dataclass(frozen=True)
+class LeaderStoredReport:
+    """A decrypted, validated report held by the leader until aggregation
+    (reference models.rs:102)."""
+
+    task_id: TaskId
+    metadata: ReportMetadata
+    public_share: bytes
+    leader_extensions: tuple[Extension, ...]
+    leader_input_share: bytes
+    helper_encrypted_input_share: HpkeCiphertext

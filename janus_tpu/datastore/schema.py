@@ -1,0 +1,241 @@
+"""Datastore schema.
+
+Ports the semantics of the reference's
+aggregator_core/db/00000000000001_initial_schema.up.sql (12 tables,
+SURVEY.md §2.4) in portable SQL: integer times (seconds since epoch),
+BLOB-encoded protocol objects, TEXT state enums.  "PostgreSQL is the
+checkpoint" (SURVEY.md §5.4): every resumable protocol state round-trips
+through these tables; device memory is always disposable.
+
+The DDL below runs unmodified on sqlite (the test backend).  The Postgres
+backend applies the same statements with type spellings adjusted
+(BLOB->BYTEA, AUTOINCREMENT->GENERATED ... AS IDENTITY).
+"""
+
+SCHEMA_VERSION = 1
+
+TABLES = [
+    # -- global HPKE keys (reference schema :26)
+    """
+    CREATE TABLE global_hpke_keys (
+        config_id INTEGER PRIMARY KEY,
+        config BLOB NOT NULL,
+        private_key BLOB NOT NULL,  -- encrypted
+        state TEXT NOT NULL DEFAULT 'PENDING',
+        last_state_change_at INTEGER NOT NULL
+    )
+    """,
+    # -- taskprov peer aggregators (+ token tables folded in; reference :42,61,77)
+    """
+    CREATE TABLE taskprov_peer_aggregators (
+        id INTEGER PRIMARY KEY AUTOINCREMENT,
+        endpoint TEXT NOT NULL,
+        peer_role INTEGER NOT NULL,
+        verify_key_init BLOB NOT NULL,  -- encrypted
+        collector_hpke_config BLOB NOT NULL,
+        report_expiry_age INTEGER,
+        tolerable_clock_skew INTEGER NOT NULL,
+        aggregator_auth_tokens BLOB NOT NULL,  -- encrypted JSON array
+        collector_auth_tokens BLOB NOT NULL,   -- encrypted JSON array
+        UNIQUE (endpoint, peer_role)
+    )
+    """,
+    # -- tasks (reference :93)
+    """
+    CREATE TABLE tasks (
+        task_id BLOB PRIMARY KEY,
+        aggregator_role INTEGER NOT NULL,
+        peer_aggregator_endpoint TEXT NOT NULL,
+        query_type TEXT NOT NULL,          -- JSON: type + params
+        vdaf TEXT NOT NULL,                -- JSON VdafInstance
+        vdaf_verify_key BLOB NOT NULL,     -- encrypted
+        task_expiration INTEGER,
+        report_expiry_age INTEGER,
+        min_batch_size INTEGER NOT NULL,
+        time_precision INTEGER NOT NULL,
+        tolerable_clock_skew INTEGER NOT NULL,
+        collector_hpke_config BLOB,
+        aggregator_auth_token BLOB,        -- encrypted JSON: token (leader) / hash (helper)
+        collector_auth_token BLOB,         -- encrypted JSON: hash
+        created_at INTEGER NOT NULL
+    )
+    """,
+    # -- per-task HPKE keys (reference :167)
+    """
+    CREATE TABLE task_hpke_keys (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        config_id INTEGER NOT NULL,
+        config BLOB NOT NULL,
+        private_key BLOB NOT NULL,  -- encrypted
+        PRIMARY KEY (task_id, config_id)
+    )
+    """,
+    # -- upload counters, sharded (reference :147)
+    """
+    CREATE TABLE task_upload_counters (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        ord INTEGER NOT NULL,
+        interval_collected INTEGER NOT NULL DEFAULT 0,
+        report_decode_failure INTEGER NOT NULL DEFAULT 0,
+        report_decrypt_failure INTEGER NOT NULL DEFAULT 0,
+        report_expired INTEGER NOT NULL DEFAULT 0,
+        report_outdated_key INTEGER NOT NULL DEFAULT 0,
+        report_success INTEGER NOT NULL DEFAULT 0,
+        report_too_early INTEGER NOT NULL DEFAULT 0,
+        task_expired INTEGER NOT NULL DEFAULT 0,
+        PRIMARY KEY (task_id, ord)
+    )
+    """,
+    # -- client reports (reference :183); leader stores full shares until
+    # aggregation starts, helper stores metadata only (scrubbed)
+    """
+    CREATE TABLE client_reports (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        report_id BLOB NOT NULL,
+        client_timestamp INTEGER NOT NULL,
+        extensions BLOB,
+        public_share BLOB,
+        leader_input_share BLOB,           -- encrypted
+        helper_encrypted_input_share BLOB,
+        aggregation_started INTEGER NOT NULL DEFAULT 0,
+        PRIMARY KEY (task_id, report_id)
+    )
+    """,
+    """
+    CREATE INDEX client_reports_task_unaggregated
+        ON client_reports (task_id, client_timestamp)
+        WHERE aggregation_started = 0
+    """,
+    # -- aggregation jobs (reference :214; partial lease index :237)
+    """
+    CREATE TABLE aggregation_jobs (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        aggregation_job_id BLOB NOT NULL,
+        aggregation_param BLOB NOT NULL,
+        batch_id BLOB,                     -- fixed-size only
+        client_timestamp_interval_start INTEGER NOT NULL,
+        client_timestamp_interval_duration INTEGER NOT NULL,
+        state TEXT NOT NULL,               -- IN_PROGRESS/FINISHED/ABANDONED/DELETED
+        step INTEGER NOT NULL DEFAULT 0,
+        last_request_hash BLOB,
+        trace_context BLOB,
+        lease_expiry INTEGER NOT NULL DEFAULT 0,
+        lease_token BLOB,
+        lease_attempts INTEGER NOT NULL DEFAULT 0,
+        updated_at INTEGER NOT NULL,
+        PRIMARY KEY (task_id, aggregation_job_id)
+    )
+    """,
+    """
+    CREATE INDEX aggregation_jobs_state_and_lease_expiry
+        ON aggregation_jobs (state, lease_expiry)
+        WHERE state = 'IN_PROGRESS'
+    """,
+    # -- report aggregations: the per-report state machine (reference :252)
+    """
+    CREATE TABLE report_aggregations (
+        task_id BLOB NOT NULL,
+        aggregation_job_id BLOB NOT NULL,
+        report_id BLOB NOT NULL,
+        client_timestamp INTEGER NOT NULL,
+        ord INTEGER NOT NULL,
+        state TEXT NOT NULL,  -- START_LEADER/WAITING_LEADER/WAITING_HELPER/FINISHED/FAILED
+        public_share BLOB,
+        leader_extensions BLOB,
+        leader_input_share BLOB,           -- encrypted
+        helper_encrypted_input_share BLOB,
+        leader_prep_transition BLOB,       -- WaitingLeader
+        helper_prep_state BLOB,            -- WaitingHelper
+        prepare_error INTEGER,             -- Failed
+        last_prep_resp BLOB,               -- helper's latest PrepareResp (replay)
+        PRIMARY KEY (task_id, aggregation_job_id, ord),
+        FOREIGN KEY (task_id, aggregation_job_id)
+            REFERENCES aggregation_jobs (task_id, aggregation_job_id)
+            ON DELETE CASCADE
+    )
+    """,
+    """
+    CREATE INDEX report_aggregations_report_id
+        ON report_aggregations (task_id, report_id)
+    """,
+    # -- batch aggregations, sharded by ord (reference :298)
+    """
+    CREATE TABLE batch_aggregations (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        batch_identifier BLOB NOT NULL,    -- encoded Interval or BatchId
+        aggregation_param BLOB NOT NULL,
+        ord INTEGER NOT NULL,
+        state TEXT NOT NULL DEFAULT 'AGGREGATING',  -- AGGREGATING/COLLECTED/SCRUBBED
+        aggregate_share BLOB,
+        report_count INTEGER NOT NULL DEFAULT 0,
+        client_timestamp_interval_start INTEGER NOT NULL DEFAULT 0,
+        client_timestamp_interval_duration INTEGER NOT NULL DEFAULT 0,
+        checksum BLOB,
+        aggregation_jobs_created INTEGER NOT NULL DEFAULT 0,
+        aggregation_jobs_terminated INTEGER NOT NULL DEFAULT 0,
+        PRIMARY KEY (task_id, batch_identifier, aggregation_param, ord)
+    )
+    """,
+    # -- collection jobs (reference :332)
+    """
+    CREATE TABLE collection_jobs (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        collection_job_id BLOB NOT NULL,
+        query BLOB NOT NULL,               -- encoded Query
+        aggregation_param BLOB NOT NULL,
+        batch_identifier BLOB,             -- resolved batch identifier
+        state TEXT NOT NULL DEFAULT 'START',  -- START/FINISHED/ABANDONED/DELETED
+        report_count INTEGER,
+        client_timestamp_interval_start INTEGER,
+        client_timestamp_interval_duration INTEGER,
+        leader_aggregate_share BLOB,       -- encrypted
+        helper_encrypted_aggregate_share BLOB,
+        lease_expiry INTEGER NOT NULL DEFAULT 0,
+        lease_token BLOB,
+        lease_attempts INTEGER NOT NULL DEFAULT 0,
+        step_attempts INTEGER NOT NULL DEFAULT 0,
+        updated_at INTEGER NOT NULL,
+        PRIMARY KEY (task_id, collection_job_id)
+    )
+    """,
+    """
+    CREATE INDEX collection_jobs_state_and_lease_expiry
+        ON collection_jobs (state, lease_expiry)
+        WHERE state = 'START'
+    """,
+    # -- aggregate share jobs: helper-side cache (reference :364)
+    """
+    CREATE TABLE aggregate_share_jobs (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        batch_identifier BLOB NOT NULL,
+        aggregation_param BLOB NOT NULL,
+        helper_aggregate_share BLOB NOT NULL,  -- encrypted
+        report_count INTEGER NOT NULL,
+        checksum BLOB NOT NULL,
+        PRIMARY KEY (task_id, batch_identifier, aggregation_param)
+    )
+    """,
+    # -- outstanding batches for fixed-size queries (reference :385)
+    """
+    CREATE TABLE outstanding_batches (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        batch_id BLOB NOT NULL,
+        time_bucket_start INTEGER,
+        filled INTEGER NOT NULL DEFAULT 0,  -- fast-path count of finished reports
+        PRIMARY KEY (task_id, batch_id)
+    )
+    """,
+    # -- collected/queried batch bookkeeping for query-count enforcement
+    """
+    CREATE TABLE batch_queries (
+        task_id BLOB NOT NULL REFERENCES tasks (task_id) ON DELETE CASCADE,
+        batch_identifier BLOB NOT NULL,
+        aggregation_param BLOB NOT NULL,
+        PRIMARY KEY (task_id, batch_identifier, aggregation_param)
+    )
+    """,
+    # -- schema version bookkeeping
+    """
+    CREATE TABLE schema_version (version INTEGER NOT NULL)
+    """,
+]

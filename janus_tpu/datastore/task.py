@@ -1,0 +1,195 @@
+"""Task configuration (reference aggregator_core/src/task.rs).
+
+AggregatorTask carries every per-task parameter an aggregator needs
+(task.rs:204); QueryTypeCfg is the runtime form of the QueryType enum with
+fixed-size parameters (task.rs:36).  TaskBuilder (test util, task.rs:792)
+lives here too since in-process tests are the primary consumer.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+
+from janus_tpu.core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.messages import (
+    FIXED_SIZE,
+    TIME_INTERVAL,
+    Duration,
+    HpkeConfig,
+    Role,
+    TaskId,
+    Time,
+)
+from janus_tpu.models import VdafInstance
+
+
+@dataclass(frozen=True)
+class QueryTypeCfg:
+    """TimeInterval or FixedSize{max_batch_size, batch_time_window_size}
+    (reference task.rs:36)."""
+
+    query_type: object  # TIME_INTERVAL | FIXED_SIZE descriptor
+    max_batch_size: int | None = None
+    batch_time_window_size: Duration | None = None
+
+    @classmethod
+    def time_interval(cls) -> "QueryTypeCfg":
+        return cls(TIME_INTERVAL)
+
+    @classmethod
+    def fixed_size(cls, max_batch_size: int | None = None,
+                   batch_time_window_size: Duration | None = None) -> "QueryTypeCfg":
+        return cls(FIXED_SIZE, max_batch_size, batch_time_window_size)
+
+    def to_json_obj(self):
+        if self.query_type is TIME_INTERVAL:
+            return "TimeInterval"
+        out = {"max_batch_size": self.max_batch_size}
+        if self.batch_time_window_size is not None:
+            out["batch_time_window_size"] = self.batch_time_window_size.seconds
+        return {"FixedSize": out}
+
+    @classmethod
+    def from_json_obj(cls, obj) -> "QueryTypeCfg":
+        if obj == "TimeInterval":
+            return cls.time_interval()
+        if isinstance(obj, dict) and "FixedSize" in obj:
+            params = obj["FixedSize"] or {}
+            btws = params.get("batch_time_window_size")
+            return cls.fixed_size(
+                params.get("max_batch_size"),
+                Duration(btws) if btws is not None else None,
+            )
+        raise ValueError(f"bad query type config: {obj!r}")
+
+
+@dataclass(frozen=True)
+class AggregatorTask:
+    """Every per-task parameter (reference task.rs:204)."""
+
+    task_id: TaskId
+    peer_aggregator_endpoint: str
+    query_type: QueryTypeCfg
+    vdaf: VdafInstance
+    role: Role
+    vdaf_verify_key: bytes
+    min_batch_size: int
+    time_precision: Duration
+    tolerable_clock_skew: Duration
+    task_expiration: Time | None = None
+    report_expiry_age: Duration | None = None
+    collector_hpke_config: HpkeConfig | None = None
+    # Leader holds the token to authenticate TO the helper; helper holds the
+    # hash to authenticate the leader's requests (task.rs:502).
+    aggregator_auth_token: AuthenticationToken | None = None
+    aggregator_auth_token_hash: AuthenticationTokenHash | None = None
+    collector_auth_token_hash: AuthenticationTokenHash | None = None
+    hpke_keys: tuple[HpkeKeypair, ...] = ()
+
+    def __post_init__(self):
+        if not self.role.is_aggregator():
+            raise ValueError("task role must be an aggregator")
+        if len(self.vdaf_verify_key) != self.vdaf.verify_key_length:
+            raise ValueError("verify key length does not match VDAF")
+        if self.time_precision.seconds == 0:
+            raise ValueError("zero time precision")
+
+    def hpke_keypair_for(self, config_id) -> HpkeKeypair | None:
+        for kp in self.hpke_keys:
+            if kp.config.id == config_id:
+                return kp
+        return None
+
+    def current_hpke_keypair(self) -> HpkeKeypair:
+        if not self.hpke_keys:
+            raise ValueError("task has no HPKE keys")
+        return max(self.hpke_keys, key=lambda kp: kp.config.id.value)
+
+    def check_aggregator_auth(self, token: AuthenticationToken | None) -> bool:
+        """Helper side: validate the leader's request token."""
+        if self.aggregator_auth_token_hash is None or token is None:
+            return False
+        return self.aggregator_auth_token_hash.matches(token)
+
+    def check_collector_auth(self, token: AuthenticationToken | None) -> bool:
+        if self.collector_auth_token_hash is None or token is None:
+            return False
+        return self.collector_auth_token_hash.matches(token)
+
+
+class TaskBuilder:
+    """Test-util task factory (reference task.rs:792): builds a consistent
+    leader/helper task pair with fresh keys."""
+
+    def __init__(self, query_type: QueryTypeCfg, vdaf: VdafInstance):
+        self.task_id = TaskId.random()
+        self.query_type = query_type
+        self.vdaf = vdaf
+        self.verify_key = os.urandom(vdaf.verify_key_length)
+        self.min_batch_size = 1
+        self.time_precision = Duration(3600)
+        self.tolerable_clock_skew = Duration(60)
+        self.task_expiration = None
+        self.report_expiry_age = None
+        self.collector_keypair = HpkeKeypair.generate(100)
+        self.aggregator_auth_token = AuthenticationToken.random_bearer()
+        self.collector_auth_token = AuthenticationToken.random_bearer()
+        self.leader_hpke_keypair = HpkeKeypair.generate(1)
+        self.helper_hpke_keypair = HpkeKeypair.generate(2)
+        self.leader_endpoint = "https://leader.example.com/"
+        self.helper_endpoint = "https://helper.example.com/"
+
+    def with_min_batch_size(self, n: int) -> "TaskBuilder":
+        self.min_batch_size = n
+        return self
+
+    def with_time_precision(self, d: Duration) -> "TaskBuilder":
+        self.time_precision = d
+        return self
+
+    def with_task_expiration(self, t: Time | None) -> "TaskBuilder":
+        self.task_expiration = t
+        return self
+
+    def with_report_expiry_age(self, d: Duration | None) -> "TaskBuilder":
+        self.report_expiry_age = d
+        return self
+
+    def leader_view(self) -> AggregatorTask:
+        return AggregatorTask(
+            task_id=self.task_id,
+            peer_aggregator_endpoint=self.helper_endpoint,
+            query_type=self.query_type,
+            vdaf=self.vdaf,
+            role=Role.LEADER,
+            vdaf_verify_key=self.verify_key,
+            min_batch_size=self.min_batch_size,
+            time_precision=self.time_precision,
+            tolerable_clock_skew=self.tolerable_clock_skew,
+            task_expiration=self.task_expiration,
+            report_expiry_age=self.report_expiry_age,
+            collector_hpke_config=self.collector_keypair.config,
+            aggregator_auth_token=self.aggregator_auth_token,
+            collector_auth_token_hash=AuthenticationTokenHash.of(self.collector_auth_token),
+            hpke_keys=(self.leader_hpke_keypair,),
+        )
+
+    def helper_view(self) -> AggregatorTask:
+        return AggregatorTask(
+            task_id=self.task_id,
+            peer_aggregator_endpoint=self.leader_endpoint,
+            query_type=self.query_type,
+            vdaf=self.vdaf,
+            role=Role.HELPER,
+            vdaf_verify_key=self.verify_key,
+            min_batch_size=self.min_batch_size,
+            time_precision=self.time_precision,
+            tolerable_clock_skew=self.tolerable_clock_skew,
+            task_expiration=self.task_expiration,
+            report_expiry_age=self.report_expiry_age,
+            collector_hpke_config=self.collector_keypair.config,
+            aggregator_auth_token_hash=AuthenticationTokenHash.of(self.aggregator_auth_token),
+            hpke_keys=(self.helper_hpke_keypair,),
+        )

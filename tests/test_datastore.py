@@ -1,0 +1,349 @@
+"""Datastore: schema, CRUD, leases, crypter, tx retry, GC."""
+
+import threading
+
+import pytest
+
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import (
+    Crypter,
+    MutationTargetAlreadyExists,
+    QueryTypeCfg,
+    TaskBuilder,
+    ephemeral_datastore,
+)
+from janus_tpu.datastore import models as m
+from janus_tpu.messages import (
+    AggregationJobId,
+    AggregationJobStep,
+    BatchId,
+    CollectionJobId,
+    Duration,
+    HpkeCiphertext,
+    HpkeConfigId,
+    Interval,
+    PrepareError,
+    Query,
+    ReportId,
+    ReportIdChecksum,
+    ReportMetadata,
+    Time,
+)
+from janus_tpu.models import VdafInstance
+
+
+@pytest.fixture
+def ds():
+    return ephemeral_datastore(MockClock(Time(10_000)))
+
+
+@pytest.fixture
+def task_pair():
+    builder = TaskBuilder(QueryTypeCfg.time_interval(), VdafInstance.prio3_count())
+    return builder.leader_view(), builder.helper_view()
+
+
+def test_task_roundtrip(ds, task_pair):
+    leader, helper = task_pair
+    ds.run_tx("put", lambda tx: (tx.put_aggregator_task(leader),
+                                 tx.put_aggregator_task(helper) if False else None))
+    got = ds.run_tx("get", lambda tx: tx.get_aggregator_task(leader.task_id))
+    assert got == leader
+    assert ds.run_tx("all", lambda tx: tx.get_aggregator_tasks()) == [leader]
+    with pytest.raises(MutationTargetAlreadyExists):
+        ds.run_tx("dup", lambda tx: tx.put_aggregator_task(leader))
+    ds.run_tx("del", lambda tx: tx.delete_task(leader.task_id))
+    assert ds.run_tx("get2", lambda tx: tx.get_aggregator_task(leader.task_id)) is None
+
+
+def _store_report(tx, task, rid=None, t=1000):
+    rid = rid or ReportId.random()
+    rep = m.LeaderStoredReport(
+        task_id=task.task_id,
+        metadata=ReportMetadata(rid, Time(t)),
+        public_share=b"pub",
+        leader_extensions=(),
+        leader_input_share=b"leader-share-secret",
+        helper_encrypted_input_share=HpkeCiphertext(HpkeConfigId(1), b"enc", b"ct"),
+    )
+    tx.put_client_report(rep)
+    return rep
+
+
+def test_client_report_roundtrip_and_claim(ds, task_pair):
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    rep = ds.run_tx("put", lambda tx: _store_report(tx, leader))
+    got = ds.run_tx("get", lambda tx: tx.get_client_report(
+        leader.task_id, rep.metadata.report_id))
+    assert got == rep
+
+    with pytest.raises(MutationTargetAlreadyExists):
+        ds.run_tx("dup", lambda tx: tx.put_client_report(rep))
+
+    claimed = ds.run_tx("claim", lambda tx:
+                        tx.get_unaggregated_client_reports_for_task(leader.task_id))
+    assert [c[0] for c in claimed] == [rep.metadata.report_id]
+    # second claim returns nothing (aggregation_started flag)
+    assert ds.run_tx("claim2", lambda tx:
+                     tx.get_unaggregated_client_reports_for_task(leader.task_id)) == []
+    ds.run_tx("unmark", lambda tx: tx.mark_report_unaggregated(
+        leader.task_id, rep.metadata.report_id))
+    assert len(ds.run_tx("claim3", lambda tx:
+                         tx.get_unaggregated_client_reports_for_task(leader.task_id))) == 1
+
+    ds.run_tx("scrub", lambda tx: tx.scrub_client_report(
+        leader.task_id, rep.metadata.report_id))
+    assert ds.run_tx("get2", lambda tx: tx.get_client_report(
+        leader.task_id, rep.metadata.report_id)) is None
+    assert ds.run_tx("exists", lambda tx: tx.check_report_exists(
+        leader.task_id, rep.metadata.report_id))
+
+
+def _mk_agg_job(task, state=m.AggregationJobState.IN_PROGRESS):
+    return m.AggregationJob(
+        task_id=task.task_id,
+        id=AggregationJobId.random(),
+        aggregation_parameter=b"",
+        partial_batch_identifier=None,
+        client_timestamp_interval=Interval(Time(0), Duration(3600)),
+        state=state,
+        step=AggregationJobStep(0),
+    )
+
+
+def test_aggregation_job_lifecycle_and_leases(ds, task_pair):
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    job = _mk_agg_job(leader)
+    ds.run_tx("put", lambda tx: tx.put_aggregation_job(job))
+    got = ds.run_tx("get", lambda tx: tx.get_aggregation_job(leader.task_id, job.id))
+    assert got == job
+
+    leases = ds.run_tx("acq", lambda tx:
+                       tx.acquire_incomplete_aggregation_jobs(Duration(600), 10))
+    assert len(leases) == 1
+    assert leases[0].leased.aggregation_job_id == job.id
+    assert leases[0].lease_attempts == 1
+    # job is leased: second acquire gets nothing
+    assert ds.run_tx("acq2", lambda tx:
+                     tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)) == []
+    # lease expiry -> reacquirable (failure detection, SURVEY §5.3)
+    ds.clock.advance(Duration(601))
+    leases2 = ds.run_tx("acq3", lambda tx:
+                        tx.acquire_incomplete_aggregation_jobs(Duration(600), 10))
+    assert len(leases2) == 1 and leases2[0].lease_attempts == 2
+    # stale lease release fails
+    from janus_tpu.datastore import MutationTargetNotFound
+
+    with pytest.raises(MutationTargetNotFound):
+        ds.run_tx("rel", lambda tx: tx.release_aggregation_job(leases[0]))
+    ds.run_tx("rel2", lambda tx: tx.release_aggregation_job(leases2[0]))
+
+    finished = job.with_state(m.AggregationJobState.FINISHED)
+    ds.run_tx("upd", lambda tx: tx.update_aggregation_job(finished))
+    assert ds.run_tx("acq4", lambda tx:
+                     tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)) == []
+
+
+def test_report_aggregation_state_machine(ds, task_pair):
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    job = _mk_agg_job(leader)
+    ds.run_tx("put", lambda tx: tx.put_aggregation_job(job))
+    rid = ReportId.random()
+    ra = m.ReportAggregation(
+        task_id=leader.task_id, aggregation_job_id=job.id, report_id=rid,
+        time=Time(500), ord=0,
+        state=m.ReportAggregationState.start_leader(
+            b"pub", (), b"leader-share",
+            HpkeCiphertext(HpkeConfigId(2), b"e", b"c")),
+    )
+    ds.run_tx("ra", lambda tx: tx.put_report_aggregation(ra))
+    got = ds.run_tx("get", lambda tx:
+                    tx.get_report_aggregations_for_aggregation_job(leader.task_id, job.id))
+    assert got == [ra]
+
+    ra2 = ra.with_state(m.ReportAggregationState.waiting_leader(b"transition-bytes"))
+    ds.run_tx("upd", lambda tx: tx.update_report_aggregation(ra2))
+    got = ds.run_tx("get2", lambda tx:
+                    tx.get_report_aggregations_for_aggregation_job(leader.task_id, job.id))
+    assert got[0].state.leader_prep_transition == b"transition-bytes"
+    assert got[0].state.leader_input_share is None
+
+    ra3 = ra2.with_state(m.ReportAggregationState.failed(PrepareError.VDAF_PREP_ERROR))
+    ds.run_tx("upd2", lambda tx: tx.update_report_aggregation(ra3))
+    got = ds.run_tx("get3", lambda tx:
+                    tx.get_report_aggregations_for_aggregation_job(leader.task_id, job.id))
+    assert got[0].state.prepare_error == PrepareError.VDAF_PREP_ERROR
+
+    # replay detection across jobs
+    job2 = _mk_agg_job(leader)
+    ds.run_tx("job2", lambda tx: tx.put_aggregation_job(job2))
+    assert ds.run_tx("replay", lambda tx:
+                     tx.check_report_replayed(leader.task_id, rid, job2.id))
+    assert not ds.run_tx("replay2", lambda tx:
+                         tx.check_report_replayed(leader.task_id, rid, job.id))
+
+
+def test_batch_aggregation_shards(ds, task_pair):
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    ident = Interval(Time(0), Duration(3600))
+    ba = m.BatchAggregation(
+        task_id=leader.task_id, batch_identifier=ident, aggregation_parameter=b"",
+        ord=3, state=m.BatchAggregationState.AGGREGATING,
+        aggregate_share=b"\x01\x00\x00\x00\x00\x00\x00\x00", report_count=2,
+        client_timestamp_interval=Interval(Time(0), Duration(100)),
+        checksum=ReportIdChecksum.zero(), aggregation_jobs_created=1,
+        aggregation_jobs_terminated=0,
+    )
+    ds.run_tx("put", lambda tx: tx.put_batch_aggregation(ba))
+    got = ds.run_tx("get", lambda tx:
+                    tx.get_batch_aggregations(leader.task_id, ident, b""))
+    assert got == [ba]
+    ba2 = m.BatchAggregation(
+        task_id=leader.task_id, batch_identifier=ident, aggregation_parameter=b"",
+        ord=3, state=m.BatchAggregationState.COLLECTED,
+        aggregate_share=ba.aggregate_share, report_count=5,
+        client_timestamp_interval=ba.client_timestamp_interval,
+        checksum=ba.checksum, aggregation_jobs_created=2,
+        aggregation_jobs_terminated=2,
+    )
+    ds.run_tx("upd", lambda tx: tx.update_batch_aggregation(ba2))
+    got = ds.run_tx("get2", lambda tx:
+                    tx.get_batch_aggregations(leader.task_id, ident, b""))
+    assert got[0].report_count == 5 and got[0].state == m.BatchAggregationState.COLLECTED
+
+
+def test_collection_job_lifecycle(ds, task_pair):
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    ident = Interval(Time(0), Duration(3600))
+    job = m.CollectionJob(
+        task_id=leader.task_id, id=CollectionJobId.random(),
+        query=Query.time_interval(ident), aggregation_parameter=b"",
+        batch_identifier=ident, state=m.CollectionJobState.START,
+    )
+    ds.run_tx("put", lambda tx: tx.put_collection_job(job))
+    got = ds.run_tx("get", lambda tx: tx.get_collection_job(leader.task_id, job.id))
+    assert got == job
+
+    leases = ds.run_tx("acq", lambda tx:
+                       tx.acquire_incomplete_collection_jobs(Duration(600), 5))
+    assert len(leases) == 1
+    ds.run_tx("rel", lambda tx: tx.release_collection_job(leases[0], Duration(60)))
+    # retry delay: not acquirable until delay passes
+    assert ds.run_tx("acq2", lambda tx:
+                     tx.acquire_incomplete_collection_jobs(Duration(600), 5)) == []
+    ds.clock.advance(Duration(61))
+    assert len(ds.run_tx("acq3", lambda tx:
+                         tx.acquire_incomplete_collection_jobs(Duration(600), 5))) == 1
+
+    done = m.CollectionJob(
+        task_id=job.task_id, id=job.id, query=job.query, aggregation_parameter=b"",
+        batch_identifier=ident, state=m.CollectionJobState.FINISHED, report_count=10,
+        client_timestamp_interval=ident, leader_aggregate_share=b"share-bytes",
+        helper_encrypted_aggregate_share=HpkeCiphertext(HpkeConfigId(9), b"e", b"p"),
+    )
+    ds.run_tx("upd", lambda tx: tx.update_collection_job(done))
+    got = ds.run_tx("get2", lambda tx: tx.get_collection_job(leader.task_id, job.id))
+    assert got.state == m.CollectionJobState.FINISHED
+    assert got.leader_aggregate_share == b"share-bytes"
+
+
+def test_aggregate_share_job_and_query_count(ds, task_pair):
+    _, helper = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(helper))
+    ident = Interval(Time(0), Duration(3600))
+    asj = m.AggregateShareJob(
+        task_id=helper.task_id, batch_identifier=ident, aggregation_parameter=b"",
+        helper_aggregate_share=b"agg-share", report_count=7,
+        checksum=ReportIdChecksum.zero(),
+    )
+    ds.run_tx("put", lambda tx: tx.put_aggregate_share_job(asj))
+    got = ds.run_tx("get", lambda tx:
+                    tx.get_aggregate_share_job(helper.task_id, ident, b""))
+    assert got == asj
+    assert ds.run_tx("q1", lambda tx: tx.put_batch_query(helper.task_id, ident, b""))
+    assert not ds.run_tx("q2", lambda tx: tx.put_batch_query(helper.task_id, ident, b""))
+    assert ds.run_tx("qc", lambda tx: tx.count_batch_queries(helper.task_id, ident)) == 1
+    overlapping = ds.run_tx("ov", lambda tx:
+                            tx.get_queried_batch_intervals_overlapping(
+                                helper.task_id, Interval(Time(1800), Duration(60))))
+    assert overlapping == [ident]
+
+
+def test_global_hpke_keys_and_counters(ds, task_pair):
+    from janus_tpu.core.hpke import HpkeKeypair
+
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    kp = HpkeKeypair.generate(42)
+    ds.run_tx("put", lambda tx: tx.put_global_hpke_keypair(kp))
+    got = ds.run_tx("get", lambda tx: tx.get_global_hpke_keypairs())
+    assert got[0].keypair == kp and got[0].state == m.HpkeKeyState.PENDING
+    ds.run_tx("act", lambda tx:
+              tx.set_global_hpke_keypair_state(42, m.HpkeKeyState.ACTIVE))
+    got = ds.run_tx("get2", lambda tx: tx.get_global_hpke_keypairs())
+    assert got[0].state == m.HpkeKeyState.ACTIVE
+
+    ds.run_tx("c1", lambda tx: tx.increment_task_upload_counter(
+        leader.task_id, 0, m.TaskUploadCounter(report_success=3)))
+    ds.run_tx("c2", lambda tx: tx.increment_task_upload_counter(
+        leader.task_id, 1, m.TaskUploadCounter(report_success=2, report_too_early=1)))
+    counter = ds.run_tx("cg", lambda tx: tx.get_task_upload_counter(leader.task_id))
+    assert counter.report_success == 5 and counter.report_too_early == 1
+
+
+def test_gc(ds, task_pair):
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    ds.run_tx("r1", lambda tx: _store_report(tx, leader, t=100))
+    ds.run_tx("r2", lambda tx: _store_report(tx, leader, t=9_999))
+    # now = 10_000; expiry age 1000 -> cutoff 9000: only t=100 deleted
+    n = ds.run_tx("gc", lambda tx: tx.delete_expired_client_reports(
+        leader.task_id, Duration(1000)))
+    assert n == 1
+
+
+def test_crypter_aad_binding():
+    c = Crypter.generate()
+    ct = c.encrypt("tasks", b"row1", "col", b"secret")
+    assert c.decrypt("tasks", b"row1", "col", ct) == b"secret"
+    with pytest.raises(Exception):
+        c.decrypt("tasks", b"row2", "col", ct)
+    with pytest.raises(Exception):
+        c.decrypt("other", b"row1", "col", ct)
+    # key rotation: old key still decrypts
+    import os as _os
+
+    k1, k2 = _os.urandom(16), _os.urandom(16)
+    old = Crypter([k1])
+    ct_old = old.encrypt("t", b"r", "c", b"v")
+    rotated = Crypter([k2, k1])
+    assert rotated.decrypt("t", b"r", "c", ct_old) == b"v"
+
+
+def test_concurrent_lease_acquisition(ds, task_pair):
+    """Two threads racing to acquire: each job leased exactly once."""
+    leader, _ = task_pair
+    ds.run_tx("task", lambda tx: tx.put_aggregator_task(leader))
+    for _ in range(8):
+        ds.run_tx("j", lambda tx: tx.put_aggregation_job(_mk_agg_job(leader)))
+    results = []
+    lock = threading.Lock()
+
+    def worker():
+        leases = ds.run_tx("acq", lambda tx:
+                           tx.acquire_incomplete_aggregation_jobs(Duration(600), 8))
+        with lock:
+            results.extend(leases)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ids = [bytes(lease.leased.aggregation_job_id) for lease in results]
+    assert len(ids) == 8 and len(set(ids)) == 8
