@@ -1,0 +1,13 @@
+"""The DAP protocol engine, HTTP surface, and daemons — the analog of the
+reference's `janus_aggregator` crate (SURVEY.md §2.5, L4)."""
+
+from janus_tpu.aggregator.aggregator import (  # noqa: F401
+    Aggregator,
+    AggregatorConfig,
+    TaskAggregator,
+    merge_batch_aggregations,
+)
+from janus_tpu.aggregator.http_handlers import (  # noqa: F401
+    DapHttpServer,
+    DapRouter,
+)

@@ -1,0 +1,154 @@
+"""Leader daemon: batch unaggregated reports into aggregation jobs
+(reference aggregator/src/aggregator/aggregation_job_creator.rs:63).
+
+Each round, per leader task: atomically claim unaggregated client reports,
+group them into jobs of [min_aggregation_job_size, max_aggregation_job_size]
+(time-interval) or fill fixed-size outstanding batches (BatchCreator), write
+the AggregationJob + START_LEADER report aggregations, and scrub the client
+rows (their content now lives in the report-aggregation rows — the
+"Postgres is the checkpoint" discipline, SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from janus_tpu.aggregator.batch_creator import BatchCreator
+from janus_tpu.aggregator.query_type import batch_interval_spanning
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import Datastore
+from janus_tpu.messages import (
+    FIXED_SIZE,
+    AggregationJobId,
+    AggregationJobStep,
+    PrepareError,
+    Role,
+)
+
+
+class AggregationJobCreator:
+    def __init__(self, datastore: Datastore,
+                 min_aggregation_job_size: int = 10,
+                 max_aggregation_job_size: int = 100,
+                 tasks_update_frequency_s: float = 10.0,
+                 batch_aggregation_shard_count: int = 32):
+        self.datastore = datastore
+        self.min_job = max(1, min_aggregation_job_size)
+        self.max_job = max_aggregation_job_size
+        self.tasks_update_frequency_s = tasks_update_frequency_s
+        self.shard_count = batch_aggregation_shard_count
+        self._stop = threading.Event()
+
+    # -- one creation round (test surface) ---------------------------------
+
+    def run_once(self) -> int:
+        """Create jobs for every leader task; returns #jobs created."""
+        tasks = self.datastore.run_tx(
+            "get_tasks", lambda tx: tx.get_aggregator_tasks())
+        created = 0
+        for task in tasks:
+            if task.role is not Role.LEADER:
+                continue
+            created += self.create_jobs_for_task(task)
+        return created
+
+    def create_jobs_for_task(self, task) -> int:
+        def txn(tx):
+            claimed = tx.get_unaggregated_client_reports_for_task(
+                task.task_id, limit=5000)
+            if not claimed:
+                return 0
+            if task.query_type.query_type is FIXED_SIZE:
+                return self._create_fixed_size(tx, task, claimed)
+            return self._create_time_interval(tx, task, claimed)
+
+        return self.datastore.run_tx("create_aggregation_jobs", txn)
+
+    # -- time-interval (reference :538) ------------------------------------
+
+    def _create_time_interval(self, tx, task, claimed) -> int:
+        created = 0
+        idx = 0
+        while idx < len(claimed):
+            chunk = claimed[idx : idx + self.max_job]
+            if len(chunk) < self.min_job:
+                # Not enough for a job: release the remainder for next round.
+                for rid, _t in chunk:
+                    tx.mark_report_unaggregated(task.task_id, rid)
+                break
+            self._write_job(tx, task, chunk, partial_batch_identifier=None)
+            created += 1
+            idx += self.max_job
+        return created
+
+    # -- fixed-size (reference :712 + BatchCreator) ------------------------
+
+    def _create_fixed_size(self, tx, task, claimed) -> int:
+        bc = BatchCreator(task, self.min_job, self.max_job)
+        assignment = bc.assign(tx, claimed)
+        created = 0
+        for batch_id, reports in assignment.items():
+            idx = 0
+            while idx < len(reports):
+                chunk = reports[idx : idx + self.max_job]
+                self._write_job(tx, task, chunk,
+                                partial_batch_identifier=batch_id)
+                created += 1
+                idx += self.max_job
+        return created
+
+    def _write_job(self, tx, task, reports, partial_batch_identifier) -> None:
+        from janus_tpu.aggregator.aggregation_job_writer import (
+            AggregationJobWriter,
+            WritableReportAggregation,
+        )
+        from janus_tpu.models.vdaf_instance import prep_engine
+
+        job_id = AggregationJobId.random()
+        times = [t for _rid, t in reports]
+        job = m.AggregationJob(
+            task_id=task.task_id, id=job_id, aggregation_parameter=b"",
+            partial_batch_identifier=partial_batch_identifier,
+            client_timestamp_interval=batch_interval_spanning(times),
+            state=m.AggregationJobState.IN_PROGRESS,
+            step=AggregationJobStep(0),
+        )
+        writables = []
+        scrub = []
+        for ord_, (rid, t) in enumerate(reports):
+            stored = tx.get_client_report(task.task_id, rid)
+            if stored is None:
+                # report content lost (e.g. GC'd between claim and write)
+                state = m.ReportAggregationState.failed(
+                    PrepareError.REPORT_DROPPED)
+            else:
+                state = m.ReportAggregationState.start_leader(
+                    stored.public_share, stored.leader_extensions,
+                    stored.leader_input_share,
+                    stored.helper_encrypted_input_share)
+                scrub.append(rid)
+            writables.append(WritableReportAggregation(m.ReportAggregation(
+                task_id=task.task_id, aggregation_job_id=job_id, report_id=rid,
+                time=t, ord=ord_, state=state)))
+        # InitialWrite through the job writer so the touched batch shards'
+        # aggregation_jobs_created counters increment (collection readiness).
+        writer = AggregationJobWriter(task, prep_engine(task.vdaf),
+                                      shard_count=self.shard_count, initial=True)
+        writer.write(tx, job, writables)
+        for rid in scrub:
+            tx.scrub_client_report(task.task_id, rid)
+
+    # -- daemon loop -------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            self._stop.wait(self.tasks_update_frequency_s)
+
+    def stop(self) -> None:
+        self._stop.set()

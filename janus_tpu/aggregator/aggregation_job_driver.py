@@ -1,0 +1,242 @@
+"""Leader daemon: step aggregation jobs against the helper
+(reference aggregator/src/aggregator/aggregation_job_driver.rs:48).
+
+Per leased job: load the per-report state from the store (tx1), run the
+batched leader prepare on device (OUTSIDE any transaction — SURVEY.md §7
+hard part 6), exchange one ping-pong round with the helper over HTTP, fold
+the helper's responses (leader_continued), then write everything back and
+accumulate finished output shares into batch-aggregation shards (tx2,
+AggregationJobWriter).  Abandons a job after `maximum_attempts_before_failure`
+lease attempts (reference :703)."""
+
+from __future__ import annotations
+
+from janus_tpu.aggregator.aggregation_job_writer import (
+    AggregationJobWriter,
+    WritableReportAggregation,
+)
+from janus_tpu.aggregator.http_client import PeerClient, PeerHttpError
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import Datastore
+from janus_tpu.messages import (
+    AggregationJobContinueReq,
+    Duration,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    PartialBatchSelector,
+    PrepareError,
+    PrepareInit,
+    PrepareResp,
+    PrepareStepResult,
+    ReportMetadata,
+    ReportShare,
+)
+from janus_tpu.models.vdaf_instance import prep_engine
+from janus_tpu.vdaf import ping_pong
+
+
+class AggregationJobDriver:
+    def __init__(self, datastore: Datastore, peer_client: PeerClient | None = None,
+                 batch_aggregation_shard_count: int = 32,
+                 maximum_attempts_before_failure: int = 10,
+                 lease_duration_s: int = 600):
+        self.datastore = datastore
+        self.peer = peer_client or PeerClient()
+        self.shard_count = batch_aggregation_shard_count
+        self.max_attempts = maximum_attempts_before_failure
+        self.lease_duration = Duration(lease_duration_s)
+
+    # -- JobDriver callbacks ----------------------------------------------
+
+    def acquirer(self, limit: int):
+        return self.datastore.run_tx(
+            "acquire_agg_jobs",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                self.lease_duration, limit))
+
+    def stepper(self, lease: m.Lease) -> None:
+        if lease.lease_attempts > self.max_attempts:
+            self.abandon_aggregation_job(lease)
+            return
+        try:
+            self.step_aggregation_job(lease)
+        except PeerHttpError:
+            # Release for retry; abandonment kicks in via lease_attempts.
+            self._release(lease)
+            raise
+
+    # -- stepping (reference :111) ----------------------------------------
+
+    def step_aggregation_job(self, lease: m.Lease) -> None:
+        acquired: m.AcquiredAggregationJob = lease.leased
+        task_id = acquired.task_id
+        job_id = acquired.aggregation_job_id
+
+        def load(tx):
+            task = tx.get_aggregator_task(task_id)
+            job = tx.get_aggregation_job(task_id, job_id)
+            ras = tx.get_report_aggregations_for_aggregation_job(task_id, job_id)
+            return task, job, ras
+
+        task, job, ras = self.datastore.run_tx("step_agg_job_load", load)
+        if task is None or job is None:
+            self._release(lease)
+            return
+        if job.state is not m.AggregationJobState.IN_PROGRESS:
+            self._release(lease)
+            return
+
+        engine = prep_engine(task.vdaf)
+        starts = [ra for ra in ras
+                  if ra.state.kind is m.ReportAggregationStateKind.START_LEADER]
+        if starts:
+            self._step_init(task, engine, job, ras, lease)
+        else:
+            # Nothing to do (multi-round continuation plugs in here when a
+            # >1-round VDAF lands); mark finished if no report is waiting.
+            self._finalize(task, engine, job, [
+                WritableReportAggregation(ra) for ra in ras
+            ], lease)
+
+    def _step_init(self, task, engine, job, ras, lease) -> None:
+        starts = [ra for ra in ras
+                  if ra.state.kind is m.ReportAggregationStateKind.START_LEADER]
+        nonces = [bytes(ra.report_id) for ra in starts]
+        pubs = [ra.state.public_share for ra in starts]
+        shares = [ra.state.leader_input_share for ra in starts]
+
+        # Device: batched leader prepare (reference per-report loop :344).
+        prepared = engine.leader_init_batch(task.vdaf_verify_key, nonces,
+                                            pubs, shares)
+
+        prepare_inits = []
+        continued = []  # (ra, PreparedReport)
+        failed = []  # (ra, PrepareError)
+        for ra, rep in zip(starts, prepared):
+            if rep.status != "continued":
+                failed.append((ra, PrepareError.VDAF_PREP_ERROR))
+                continue
+            rs = ReportShare(
+                ReportMetadata(ra.report_id, ra.time),
+                ra.state.public_share,
+                ra.state.helper_encrypted_input_share,
+            )
+            prepare_inits.append(PrepareInit(rs, rep.outbound.encode()))
+            continued.append((ra, rep))
+
+        resps = {}
+        if prepare_inits:
+            req = AggregationJobInitializeReq(
+                aggregation_parameter=job.aggregation_parameter,
+                partial_batch_selector=PartialBatchSelector(
+                    task.query_type.query_type, job.partial_batch_identifier),
+                prepare_inits=tuple(prepare_inits),
+            )
+            result = self.peer.send_to_helper(
+                task, "PUT", f"tasks/{task.task_id}/aggregation_jobs/{job.id}",
+                req.encode(), AggregationJobInitializeReq.MEDIA_TYPE)
+            resp = AggregationJobResp.decode(result.body)
+            resps = {bytes(pr.report_id): pr for pr in resp.prepare_resps}
+
+        # Fold helper responses (reference process_response_from_helper :540).
+        writables = []
+        reps, msgs, ras_resp = [], [], []
+        for ra, rep in continued:
+            pr = resps.get(bytes(ra.report_id))
+            if pr is None:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.failed(
+                        PrepareError.INVALID_MESSAGE))))
+                continue
+            if pr.result.kind == PrepareStepResult.REJECT:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.failed(
+                        pr.result.error))))
+                continue
+            if pr.result.kind != PrepareStepResult.CONTINUE:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.failed(
+                        PrepareError.INVALID_MESSAGE))))
+                continue
+            try:
+                msg = ping_pong.PingPongMessage.decode(pr.result.message)
+            except Exception:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.failed(
+                        PrepareError.INVALID_MESSAGE))))
+                continue
+            reps.append(rep)
+            msgs.append(msg)
+            ras_resp.append(ra)
+
+        finished = engine.leader_finish(reps, msgs)
+        for ra, rep in zip(ras_resp, finished):
+            if rep.status == "finished":
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.finished()),
+                    rep.out_share_raw))
+            elif rep.status == "continued":
+                # multi-round: persist the transition for the next step
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.waiting_leader(
+                        rep.prep_share or b""))))
+            else:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.failed(
+                        PrepareError.VDAF_PREP_ERROR))))
+
+        for ra, perr in failed:
+            writables.append(WritableReportAggregation(
+                ra.with_state(m.ReportAggregationState.failed(perr))))
+
+        # Keep non-START reports unchanged.
+        handled = {bytes(w.report_aggregation.report_id) for w in writables}
+        for ra in ras:
+            if bytes(ra.report_id) not in handled:
+                writables.append(WritableReportAggregation(ra))
+
+        job = job.with_step(job.step.increment())
+        self._finalize(task, engine, job, writables, lease)
+
+    def _finalize(self, task, engine, job, writables, lease) -> None:
+        def txn(tx):
+            writer = AggregationJobWriter(
+                task, engine, shard_count=self.shard_count, initial=False)
+            writer.write(tx, job, writables)
+            tx.release_aggregation_job(lease)
+
+        self.datastore.run_tx("step_agg_job_write", txn)
+
+    # -- abandonment (reference :703) --------------------------------------
+
+    def abandon_aggregation_job(self, lease: m.Lease) -> None:
+        """Terminal failure: the writer increments the batch shards'
+        aggregation_jobs_terminated so collection readiness still converges."""
+        acquired = lease.leased
+
+        def txn(tx):
+            task = tx.get_aggregator_task(acquired.task_id)
+            job = tx.get_aggregation_job(acquired.task_id,
+                                         acquired.aggregation_job_id)
+            if task is not None and job is not None:
+                ras = tx.get_report_aggregations_for_aggregation_job(
+                    acquired.task_id, acquired.aggregation_job_id)
+                writer = AggregationJobWriter(
+                    task, prep_engine(task.vdaf), shard_count=self.shard_count,
+                    initial=False,
+                    job_state_override=m.AggregationJobState.ABANDONED)
+                writer.write(tx, job, [
+                    WritableReportAggregation(ra) for ra in ras
+                ])
+            tx.release_aggregation_job(lease)
+
+        self.datastore.run_tx("abandon_agg_job", txn)
+
+    def _release(self, lease: m.Lease) -> None:
+        def txn(tx):
+            try:
+                tx.release_aggregation_job(lease)
+            except Exception:
+                pass
+
+        self.datastore.run_tx("release_agg_job", txn)

@@ -1,0 +1,215 @@
+"""Transactional writer for aggregation jobs + report aggregations +
+sharded batch-aggregation accumulation
+(reference aggregator/src/aggregator/aggregation_job_writer.rs:35).
+
+The expensive per-report math happens OUTSIDE the transaction (device
+kernels must never run under run_tx — SURVEY.md §7 hard part 6); this module
+takes the already-computed per-report outcomes and performs the pure-state
+write: job row, report-aggregation rows, and the accumulation of finished
+output shares into a random batch-aggregation shard
+(`ord` ∈ [0, shard_count), spreading row contention — SURVEY.md §P4).
+
+Deterministic orderings (sorted batch identifiers) mirror the reference's
+deadlock-avoidance discipline (aggregation_job_writer.rs:197-219).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, replace
+
+from janus_tpu.aggregator.query_type import batch_interval_spanning, logic_for
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import MutationTargetAlreadyExists
+from janus_tpu.datastore.task import AggregatorTask
+from janus_tpu.messages import Interval, PrepareError, ReportIdChecksum
+
+
+@dataclass
+class WritableReportAggregation:
+    """A report aggregation plus (if it finished) its raw output share."""
+
+    report_aggregation: m.ReportAggregation
+    output_share_raw: object | None = None  # np.ndarray, engine raw form
+
+    def with_failure(self, error: PrepareError) -> "WritableReportAggregation":
+        from janus_tpu.messages import PrepareResp, PrepareStepResult
+
+        ra = self.report_aggregation
+        ra = ra.with_state(m.ReportAggregationState.failed(error)).with_last_prep_resp(
+            PrepareResp(ra.report_id, PrepareStepResult.rejected(error))
+        )
+        return WritableReportAggregation(ra, None)
+
+
+class AggregationJobWriter:
+    """One write of one aggregation job and its report aggregations.
+
+    initial=True -> InitialWrite (helper aggregate-init, leader job creation):
+    report aggregations are INSERTed and `aggregation_jobs_created` is
+    incremented on the touched batch shards.
+    initial=False -> UpdateWrite (leader stepping, helper continue): rows are
+    UPDATEd.  In both modes, if the job reaches a terminal state,
+    `aggregation_jobs_terminated` is incremented.
+    """
+
+    def __init__(self, task: AggregatorTask, engine, shard_count: int = 1,
+                 initial: bool = True, rng: random.Random | None = None,
+                 job_state_override: m.AggregationJobState | None = None):
+        self.task = task
+        self.engine = engine  # BatchPrio3 | HostPrepEngine (for aggregate_raw_rows)
+        self.shard_count = max(1, shard_count)
+        self.initial = initial
+        self.rng = rng or random
+        self.logic = logic_for(task.query_type.query_type)
+        self.job_state_override = job_state_override
+
+    def write(self, tx, job: m.AggregationJob,
+              writables: list[WritableReportAggregation]) -> list:
+        """Perform the write under an open transaction; returns the final
+        per-report PrepareResps (helper) / the final writables."""
+        vdaf = self.engine.vdaf
+
+        # Batches already collected reject new contributions: check the
+        # batch state for every touched identifier first
+        # (reference aggregation_job_writer.rs: update of COLLECTED -> failure).
+        by_batch: dict[bytes, list[WritableReportAggregation]] = {}
+        idents: dict[bytes, object] = {}
+        for w in writables:
+            ra = w.report_aggregation
+            ident = self.logic.to_batch_identifier(
+                self.task, job.partial_batch_identifier, ra.time)
+            key = m.encode_batch_identifier(ident)
+            idents[key] = ident
+            by_batch.setdefault(key, []).append(w)
+
+        collected: set[bytes] = set()
+        for key in sorted(idents):
+            shards = tx.get_batch_aggregations(
+                self.task.task_id, idents[key], job.aggregation_parameter)
+            if any(ba.state is not m.BatchAggregationState.AGGREGATING
+                   for ba in shards):
+                collected.add(key)
+
+        final: list[WritableReportAggregation] = []
+        for w in writables:
+            ra = w.report_aggregation
+            ident = self.logic.to_batch_identifier(
+                self.task, job.partial_batch_identifier, ra.time)
+            if (m.encode_batch_identifier(ident) in collected
+                    and ra.state.kind is not m.ReportAggregationStateKind.FAILED):
+                w = w.with_failure(PrepareError.BATCH_COLLECTED)
+            final.append(w)
+
+        # Job terminal state: finished unless some report is still waiting.
+        waiting = any(
+            w.report_aggregation.state.kind in (
+                m.ReportAggregationStateKind.START_LEADER,
+                m.ReportAggregationStateKind.WAITING_LEADER,
+                m.ReportAggregationStateKind.WAITING_HELPER,
+            )
+            for w in final
+        )
+        if self.job_state_override is not None:
+            new_state = self.job_state_override
+        else:
+            new_state = (m.AggregationJobState.IN_PROGRESS if waiting
+                         else m.AggregationJobState.FINISHED)
+        terminal = new_state in (m.AggregationJobState.FINISHED,
+                                 m.AggregationJobState.ABANDONED)
+        job = job.with_state(new_state)
+
+        if self.initial:
+            tx.put_aggregation_job(job)
+            for w in final:
+                tx.put_report_aggregation(w.report_aggregation)
+        else:
+            tx.update_aggregation_job(job)
+            for w in final:
+                tx.update_report_aggregation(w.report_aggregation)
+
+        # Accumulate finished output shares into one random shard per batch.
+        for key in sorted(by_batch):
+            ident = idents[key]
+            group = by_batch[key]
+            rows = [w.output_share_raw for w in group
+                    if w.output_share_raw is not None
+                    and w.report_aggregation.state.kind
+                    is m.ReportAggregationStateKind.FINISHED]
+            count = len(rows)
+            checksum = ReportIdChecksum.zero()
+            times = []
+            for w in group:
+                ra = w.report_aggregation
+                if (w.output_share_raw is not None and ra.state.kind
+                        is m.ReportAggregationStateKind.FINISHED):
+                    checksum = checksum.updated_with(ra.report_id)
+                    times.append(ra.time)
+            if rows:
+                delta_share = self.engine.aggregate_raw_rows(rows)
+                interval = batch_interval_spanning(times)
+            else:
+                delta_share = None
+                interval = Interval.for_time(group[0].report_aggregation.time,
+                                             self.task.time_precision)
+
+            ord_ = self.rng.randrange(self.shard_count)
+            self._accumulate_shard(
+                tx, vdaf, ident, job.aggregation_parameter, ord_, delta_share,
+                count, interval, checksum,
+                created_delta=1 if self.initial else 0,
+                terminated_delta=1 if terminal else 0,
+            )
+
+        return final
+
+    def _accumulate_shard(self, tx, vdaf, ident, agg_param: bytes, ord_: int,
+                          delta_share, count: int, interval: Interval,
+                          checksum: ReportIdChecksum, created_delta: int,
+                          terminated_delta: int) -> None:
+        existing = {
+            ba.ord: ba
+            for ba in tx.get_batch_aggregations(self.task.task_id, ident, agg_param)
+        }
+        delta = m.BatchAggregation(
+            task_id=self.task.task_id,
+            batch_identifier=ident,
+            aggregation_parameter=agg_param,
+            ord=ord_,
+            state=m.BatchAggregationState.AGGREGATING,
+            aggregate_share=(vdaf.encode_agg_share(delta_share)
+                            if delta_share is not None else None),
+            report_count=count,
+            client_timestamp_interval=interval,
+            checksum=checksum,
+            aggregation_jobs_created=created_delta,
+            aggregation_jobs_terminated=terminated_delta,
+        )
+        prior = existing.get(ord_)
+        if prior is None:
+            try:
+                tx.put_batch_aggregation(delta)
+            except MutationTargetAlreadyExists:
+                # Put/Put race under concurrent writers: re-read and merge
+                # (reference aggregation_job_writer.rs:224-252 retries; our
+                # run_tx serialization makes a plain merge safe here).
+                prior = {
+                    ba.ord: ba for ba in tx.get_batch_aggregations(
+                        self.task.task_id, ident, agg_param)
+                }[ord_]
+                tx.update_batch_aggregation(self._merge(vdaf, prior, delta))
+        else:
+            tx.update_batch_aggregation(self._merge(vdaf, prior, delta))
+
+    def _merge(self, vdaf, a: m.BatchAggregation,
+               b: m.BatchAggregation) -> m.BatchAggregation:
+        def merge_shares(x: bytes | None, y: bytes | None) -> bytes | None:
+            if x is None:
+                return y
+            if y is None:
+                return x
+            return vdaf.encode_agg_share(vdaf.aggregate_update(
+                vdaf.decode_agg_share(x), vdaf.decode_agg_share(y)))
+
+        merged = a.merged_with(b, merge_shares)
+        return replace(merged, state=m.BatchAggregationState.AGGREGATING)

@@ -1,0 +1,708 @@
+"""The DAP protocol engine: Aggregator / TaskAggregator / VdafOps
+(reference aggregator/src/aggregator.rs:164,854,1156).
+
+Design: HTTP/codec/HPKE/datastore work happens here on the host; the
+per-report VDAF math is routed through the batched prepare engine
+(janus_tpu.engine) as ONE device program per request — the reference's
+sequential per-report loop (aggregator.rs:1763) is the part this framework
+re-architects.  Device work always runs OUTSIDE datastore transactions
+(SURVEY.md §7 hard part 6).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time as _time
+from dataclasses import dataclass, field
+
+from janus_tpu.aggregator import error as err
+from janus_tpu.aggregator.aggregation_job_writer import (
+    AggregationJobWriter,
+    WritableReportAggregation,
+)
+from janus_tpu.aggregator.query_type import batch_interval_spanning, logic_for
+from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+from janus_tpu.core import hpke
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.time import Clock
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import (
+    Datastore,
+    MutationTargetAlreadyExists,
+)
+from janus_tpu.datastore.task import AggregatorTask
+from janus_tpu.messages import (
+    AggregateShare,
+    AggregateShareAad,
+    AggregateShareReq,
+    AggregationJobContinueReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    AggregationJobStep,
+    BatchSelector,
+    Collection,
+    CollectionJobId,
+    CollectionReq,
+    Duration,
+    HpkeConfigList,
+    InputShareAad,
+    Interval,
+    PartialBatchSelector,
+    PlaintextInputShare,
+    PrepareError,
+    PrepareResp,
+    PrepareStepResult,
+    Report,
+    Role,
+    TaskId,
+)
+from janus_tpu.models.vdaf_instance import prep_engine
+from janus_tpu.vdaf import ping_pong
+from janus_tpu.vdaf.prio3 import VdafError
+
+
+@dataclass
+class AggregatorConfig:
+    """reference aggregator.rs:196."""
+
+    max_upload_batch_size: int = 100
+    max_upload_batch_write_delay_ms: int = 250
+    batch_aggregation_shard_count: int = 32
+    max_batch_query_count: int = 1
+    taskprov_enabled: bool = False
+    require_global_hpke_keys: bool = False
+    task_cache_ttl_s: float = 600.0
+
+
+class TaskAggregator:
+    """Per-task protocol ops: the vdaf_dispatch! seam resolved once
+    (reference aggregator.rs:854)."""
+
+    def __init__(self, task: AggregatorTask):
+        self.task = task
+        self.engine = prep_engine(task.vdaf)
+        self.vdaf = self.engine.vdaf
+        self.logic = logic_for(task.query_type.query_type)
+
+    def hpke_config_list(self) -> HpkeConfigList:
+        return HpkeConfigList(tuple(
+            kp.config for kp in self.task.hpke_keys
+        ))
+
+
+class Aggregator:
+    """Process root (reference aggregator.rs:164)."""
+
+    def __init__(self, datastore: Datastore, clock: Clock,
+                 cfg: AggregatorConfig | None = None):
+        self.datastore = datastore
+        self.clock = clock
+        self.cfg = cfg or AggregatorConfig()
+        self._task_aggs: dict[bytes, tuple[float, TaskAggregator]] = {}
+        self._task_lock = threading.Lock()
+        self.report_writer = ReportWriteBatcher(
+            datastore,
+            max_batch_size=self.cfg.max_upload_batch_size,
+            max_batch_write_delay_ms=self.cfg.max_upload_batch_write_delay_ms,
+        )
+
+    # -- task cache (reference aggregator.rs:662) -------------------------
+
+    def task_aggregator(self, task_id: TaskId) -> TaskAggregator:
+        key = bytes(task_id)
+        now = _time.monotonic()
+        with self._task_lock:
+            hit = self._task_aggs.get(key)
+            if hit is not None and now - hit[0] < self.cfg.task_cache_ttl_s:
+                return hit[1]
+        task = self.datastore.run_tx(
+            "get_task", lambda tx: tx.get_aggregator_task(task_id))
+        if task is None:
+            raise err.UnrecognizedTask(task_id)
+        ta = TaskAggregator(task)
+        with self._task_lock:
+            self._task_aggs[key] = (now, ta)
+        return ta
+
+    def invalidate_task_cache(self, task_id: TaskId | None = None) -> None:
+        with self._task_lock:
+            if task_id is None:
+                self._task_aggs.clear()
+            else:
+                self._task_aggs.pop(bytes(task_id), None)
+
+    # -- authentication ---------------------------------------------------
+
+    @staticmethod
+    def _check_aggregator_auth(task: AggregatorTask,
+                               token: AuthenticationToken | None) -> None:
+        if not task.check_aggregator_auth(token):
+            raise err.UnauthorizedRequest("aggregator authentication failed",
+                                          task.task_id)
+
+    @staticmethod
+    def _check_collector_auth(task: AggregatorTask,
+                              token: AuthenticationToken | None) -> None:
+        if not task.check_collector_auth(token):
+            raise err.UnauthorizedRequest("collector authentication failed",
+                                          task.task_id)
+
+    # -- GET /hpke_config (reference aggregator.rs:309) -------------------
+
+    def handle_hpke_config(self, task_id: TaskId | None) -> bytes:
+        if task_id is None:
+            # Global keys (if provisioned) serve the task-independent path.
+            keypairs = self.datastore.run_tx(
+                "get_global_hpke", lambda tx: tx.get_global_hpke_keypairs())
+            active = [gk.keypair.config for gk in keypairs
+                      if gk.state is m.HpkeKeyState.ACTIVE]
+            if not active:
+                raise err.MissingTaskId("task_id required when no global HPKE"
+                                        " keys are configured")
+            return HpkeConfigList(tuple(active)).encode()
+        ta = self.task_aggregator(task_id)
+        return ta.hpke_config_list().encode()
+
+    # -- upload (reference aggregator.rs:1513) ----------------------------
+
+    def handle_upload(self, task_id: TaskId, body: bytes) -> None:
+        ta = self.task_aggregator(task_id)
+        task = ta.task
+        if task.role is not Role.LEADER:
+            raise err.UnrecognizedTask(task_id)
+        try:
+            report = Report.decode(body)
+        except Exception as e:
+            raise err.InvalidMessage(f"malformed report: {e}", task_id) from e
+
+        def reject(reason: err.ReportRejectionReason):
+            rejection = err.ReportRejection(
+                task_id, report.metadata.report_id, report.metadata.time, reason)
+            self.report_writer.write_rejection(rejection)
+            raise err.ReportRejected(rejection)
+
+        report_deadline = self.clock.now().add(task.tolerable_clock_skew)
+        if report.metadata.time.is_after(report_deadline):
+            reject(err.ReportRejectionReason.TOO_EARLY)
+        if (task.task_expiration is not None
+                and report.metadata.time.is_after(task.task_expiration)):
+            reject(err.ReportRejectionReason.TASK_EXPIRED)
+        if task.report_expiry_age is not None:
+            expiry = report.metadata.time.add(task.report_expiry_age)
+            if self.clock.now().is_after(expiry):
+                reject(err.ReportRejectionReason.EXPIRED)
+
+        # Decode public share eagerly (exercises the codec so the
+        # aggregation path can trust stored bytes).
+        try:
+            ta.vdaf.decode_public_share(report.public_share)
+        except (VdafError, ValueError) as e:
+            reject(err.ReportRejectionReason.DECODE_FAILURE)
+
+        aad = InputShareAad(task_id, report.metadata, report.public_share).encode()
+        keypair = task.hpke_keypair_for(report.leader_encrypted_input_share.config_id)
+        if keypair is None:
+            keypair = self._global_keypair(
+                report.leader_encrypted_input_share.config_id)
+        if keypair is None:
+            reject(err.ReportRejectionReason.OUTDATED_HPKE_CONFIG)
+        try:
+            plaintext = hpke.open_ciphertext(
+                keypair,
+                hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT, task.role),
+                report.leader_encrypted_input_share,
+                aad,
+            )
+        except hpke.HpkeError:
+            reject(err.ReportRejectionReason.DECRYPT_FAILURE)
+        try:
+            pis = PlaintextInputShare.decode(plaintext)
+            ta.vdaf.decode_input_share(0, pis.payload)
+        except (VdafError, ValueError, Exception) as e:
+            if not isinstance(e, (VdafError, ValueError)) and not str(e):
+                raise
+            reject(err.ReportRejectionReason.DECODE_FAILURE)
+
+        stored = m.LeaderStoredReport(
+            task_id=task_id,
+            metadata=report.metadata,
+            public_share=report.public_share,
+            leader_extensions=tuple(pis.extensions),
+            leader_input_share=pis.payload,
+            helper_encrypted_input_share=report.helper_encrypted_input_share,
+        )
+        self.report_writer.write_report(task, ta.logic, stored)
+
+    def _global_keypair(self, config_id):
+        keypairs = self.datastore.run_tx(
+            "get_global_hpke", lambda tx: tx.get_global_hpke_keypairs())
+        for gk in keypairs:
+            if (gk.keypair.config.id == config_id
+                    and gk.state is m.HpkeKeyState.ACTIVE):
+                return gk.keypair
+        return None
+
+    # -- helper aggregate-init (reference aggregator.rs:1712) -------------
+
+    def handle_aggregate_init(self, task_id: TaskId, job_id: AggregationJobId,
+                              body: bytes,
+                              auth: AuthenticationToken | None) -> bytes:
+        ta = self.task_aggregator(task_id)
+        task = ta.task
+        if task.role is not Role.HELPER:
+            raise err.UnrecognizedTask(task_id)
+        self._check_aggregator_auth(task, auth)
+
+        request_hash = hashlib.sha256(body).digest()
+        try:
+            req = AggregationJobInitializeReq.decode(body)
+        except Exception as e:
+            raise err.InvalidMessage(f"malformed request: {e}", task_id) from e
+        if req.partial_batch_selector.query_type is not task.query_type.query_type:
+            raise err.InvalidMessage("query type mismatch", task_id)
+        if not req.prepare_inits:
+            raise err.EmptyAggregation(task_id)
+
+        # Duplicate report IDs within one request: whole-request abort (§4.5.1.2).
+        seen: set[bytes] = set()
+        for pi in req.prepare_inits:
+            rid = bytes(pi.report_share.metadata.report_id)
+            if rid in seen:
+                raise err.InvalidMessage(
+                    "aggregate request contains duplicate report IDs", task_id)
+            seen.add(rid)
+
+        report_deadline = self.clock.now().add(task.tolerable_clock_skew)
+
+        # Phase 1 (host): HPKE open + plaintext/message decode, per report.
+        # Failures become per-lane PrepareErrors, never whole-batch aborts
+        # (SURVEY.md §7 hard part 3).
+        n = len(req.prepare_inits)
+        lane_error: dict[int, PrepareError] = {}
+        nonces, pubs, shares, inbounds = [], [], [], []
+        lane_of = []  # engine lane -> request index
+        for i, pi in enumerate(req.prepare_inits):
+            rs = pi.report_share
+            aad = InputShareAad(task_id, rs.metadata, rs.public_share).encode()
+            keypair = task.hpke_keypair_for(rs.encrypted_input_share.config_id)
+            if keypair is None:
+                keypair = self._global_keypair(rs.encrypted_input_share.config_id)
+            if keypair is None:
+                lane_error[i] = PrepareError.HPKE_UNKNOWN_CONFIG_ID
+                continue
+            try:
+                plaintext = hpke.open_ciphertext(
+                    keypair,
+                    hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT,
+                                          Role.HELPER),
+                    rs.encrypted_input_share, aad)
+            except hpke.HpkeError:
+                lane_error[i] = PrepareError.HPKE_DECRYPT_ERROR
+                continue
+            try:
+                pis = PlaintextInputShare.decode(plaintext)
+                ext_types = [e.extension_type for e in pis.extensions]
+                if len(ext_types) != len(set(ext_types)):
+                    raise ValueError("duplicate extensions")
+            except Exception:
+                lane_error[i] = PrepareError.INVALID_MESSAGE
+                continue
+            if rs.metadata.time.is_after(report_deadline):
+                lane_error[i] = PrepareError.REPORT_TOO_EARLY
+                continue
+            try:
+                inbound = ping_pong.PingPongMessage.decode(pi.message)
+            except VdafError:
+                lane_error[i] = PrepareError.INVALID_MESSAGE
+                continue
+            lane_of.append(i)
+            nonces.append(bytes(rs.metadata.report_id))
+            pubs.append(rs.public_share)
+            shares.append(pis.payload)
+            inbounds.append(inbound)
+
+        # Phase 2 (device): one batched prepare over all surviving lanes.
+        prepared = ta.engine.helper_init_batch(
+            task.vdaf_verify_key, nonces, pubs, shares, inbounds)
+
+        # Phase 3: assemble per-report outcomes.
+        writables: list[WritableReportAggregation] = []
+        by_lane = dict(zip(lane_of, prepared))
+        for i, pi in enumerate(req.prepare_inits):
+            rs = pi.report_share
+            rid = rs.metadata.report_id
+            out_share = None
+            if i in lane_error:
+                state = m.ReportAggregationState.failed(lane_error[i])
+                result = PrepareStepResult.rejected(lane_error[i])
+            else:
+                rep = by_lane[i]
+                if rep.status == "finished":
+                    state = m.ReportAggregationState.finished()
+                    result = PrepareStepResult.continued(rep.outbound.encode())
+                    out_share = rep.out_share_raw
+                elif rep.status == "continued":
+                    # multi-round VDAF: helper waits for the leader
+                    state = m.ReportAggregationState.waiting_helper(
+                        rep.prep_share or b"")
+                    result = PrepareStepResult.continued(rep.outbound.encode())
+                else:
+                    state = m.ReportAggregationState.failed(
+                        PrepareError.VDAF_PREP_ERROR)
+                    result = PrepareStepResult.rejected(PrepareError.VDAF_PREP_ERROR)
+            ra = m.ReportAggregation(
+                task_id=task_id, aggregation_job_id=job_id, report_id=rid,
+                time=rs.metadata.time, ord=i, state=state,
+                last_prep_resp=PrepareResp(rid, result),
+            )
+            writables.append(WritableReportAggregation(ra, out_share))
+
+        times = [pi.report_share.metadata.time for pi in req.prepare_inits]
+        job = m.AggregationJob(
+            task_id=task_id, id=job_id,
+            aggregation_parameter=req.aggregation_parameter,
+            partial_batch_identifier=req.partial_batch_selector.batch_identifier,
+            client_timestamp_interval=batch_interval_spanning(times),
+            state=m.AggregationJobState.IN_PROGRESS,
+            step=AggregationJobStep(0),
+            last_request_hash=request_hash,
+        )
+
+        # Phase 4 (tx): replay/idempotency + writes.
+        def txn(tx):
+            existing = tx.get_aggregation_job(task_id, job_id)
+            if existing is not None:
+                if existing.state is m.AggregationJobState.DELETED:
+                    raise err.DeletedAggregationJob(task_id, job_id)
+                if existing.last_request_hash != request_hash:
+                    raise err.ForbiddenMutation(
+                        f"aggregation job {job_id}", task_id)
+                # Repeated request: serve the stored response.
+                ras = tx.get_report_aggregations_for_aggregation_job(
+                    task_id, job_id)
+                return AggregationJobResp(tuple(
+                    ra.last_prep_resp for ra in ras if ra.last_prep_resp
+                ))
+
+            # Replay detection: a report share seen before (other jobs) fails.
+            final = []
+            for w in writables:
+                ra = w.report_aggregation
+                replayed = False
+                try:
+                    tx.put_scrubbed_report(task_id, ra.report_id, ra.time)
+                except MutationTargetAlreadyExists:
+                    replayed = True
+                if replayed or tx.check_report_replayed(task_id, ra.report_id,
+                                                        job_id):
+                    if ra.state.kind is not m.ReportAggregationStateKind.FAILED:
+                        w = w.with_failure(PrepareError.REPORT_REPLAYED)
+                final.append(w)
+
+            writer = AggregationJobWriter(
+                task, ta.engine,
+                shard_count=self.cfg.batch_aggregation_shard_count,
+                initial=True)
+            final = writer.write(tx, job, final)
+            return AggregationJobResp(tuple(
+                w.report_aggregation.last_prep_resp for w in final
+            ))
+
+        resp = self.datastore.run_tx("aggregate_init", txn)
+        return resp.encode()
+
+    # -- helper aggregate-continue (reference aggregation_job_continue.rs:34)
+
+    def handle_aggregate_continue(self, task_id: TaskId, job_id: AggregationJobId,
+                                  body: bytes,
+                                  auth: AuthenticationToken | None) -> bytes:
+        ta = self.task_aggregator(task_id)
+        task = ta.task
+        if task.role is not Role.HELPER:
+            raise err.UnrecognizedTask(task_id)
+        self._check_aggregator_auth(task, auth)
+
+        request_hash = hashlib.sha256(body).digest()
+        try:
+            req = AggregationJobContinueReq.decode(body)
+        except Exception as e:
+            raise err.InvalidMessage(f"malformed request: {e}", task_id) from e
+        if req.step.value == 0:
+            raise err.InvalidMessage(
+                "aggregation job cannot be advanced to step 0", task_id)
+
+        # Load state in one tx; do VDAF math outside; write back in another.
+        def load(tx):
+            job = tx.get_aggregation_job(task_id, job_id)
+            if job is None:
+                raise err.UnrecognizedAggregationJob(task_id, job_id)
+            if job.state is m.AggregationJobState.DELETED:
+                raise err.DeletedAggregationJob(task_id, job_id)
+            ras = tx.get_report_aggregations_for_aggregation_job(task_id, job_id)
+            return job, ras
+
+        job, ras = self.datastore.run_tx("aggregate_continue_load", load)
+
+        # Step-skew recovery (reference aggregation_job_continue.rs:597-816):
+        # a replay of the previous step with identical content is re-served;
+        # anything else out-of-order is a StepMismatch.
+        if req.step.value == job.step.value and job.last_request_hash == request_hash:
+            return AggregationJobResp(tuple(
+                ra.last_prep_resp for ra in ras if ra.last_prep_resp
+            )).encode()
+        if req.step.value != job.step.value + 1:
+            raise err.StepMismatch(
+                f"leader sent step {req.step.value}, helper is at step "
+                f"{job.step.value}", task_id)
+
+        by_id = {bytes(ra.report_id): ra for ra in ras}
+        writables: list[WritableReportAggregation] = []
+        seen_ids = set()
+        for pc in req.prepare_continues:
+            key = bytes(pc.report_id)
+            ra = by_id.get(key)
+            if ra is None:
+                raise err.InvalidMessage(
+                    "leader sent prepare step for unknown report", task_id)
+            if key in seen_ids:
+                raise err.InvalidMessage("duplicate report id", task_id)
+            seen_ids.add(key)
+            if ra.state.kind is not m.ReportAggregationStateKind.WAITING_HELPER:
+                raise err.InvalidMessage(
+                    "leader sent prepare step for non-waiting report", task_id)
+            # Multi-round continuation is oracle-driven (no 1-round VDAF
+            # reaches here; Poplar1 et al. plug in at this seam).
+            out_share = None
+            try:
+                raise VdafError("multi-round VDAF continuation not supported")
+            except VdafError:
+                state = m.ReportAggregationState.failed(PrepareError.VDAF_PREP_ERROR)
+                result = PrepareStepResult.rejected(PrepareError.VDAF_PREP_ERROR)
+            ra = ra.with_state(state).with_last_prep_resp(
+                PrepareResp(ra.report_id, result))
+            writables.append(WritableReportAggregation(ra, out_share))
+
+        job = job.with_step(req.step).with_last_request_hash(request_hash)
+
+        def txn(tx):
+            writer = AggregationJobWriter(
+                task, ta.engine,
+                shard_count=self.cfg.batch_aggregation_shard_count,
+                initial=False)
+            final = writer.write(tx, job, writables)
+            return AggregationJobResp(tuple(
+                w.report_aggregation.last_prep_resp for w in final
+            ))
+
+        resp = self.datastore.run_tx("aggregate_continue", txn)
+        return resp.encode()
+
+    # -- aggregation job delete -------------------------------------------
+
+    def handle_aggregate_delete(self, task_id: TaskId, job_id: AggregationJobId,
+                                auth: AuthenticationToken | None) -> None:
+        ta = self.task_aggregator(task_id)
+        self._check_aggregator_auth(ta.task, auth)
+
+        def txn(tx):
+            job = tx.get_aggregation_job(task_id, job_id)
+            if job is None:
+                raise err.UnrecognizedAggregationJob(task_id, job_id)
+            tx.update_aggregation_job(job.with_state(m.AggregationJobState.DELETED))
+
+        self.datastore.run_tx("aggregate_delete", txn)
+
+    # -- collection jobs, leader side (reference aggregator.rs:2351) ------
+
+    def handle_create_collection_job(self, task_id: TaskId,
+                                     job_id: CollectionJobId, body: bytes,
+                                     auth: AuthenticationToken | None) -> None:
+        ta = self.task_aggregator(task_id)
+        task = ta.task
+        if task.role is not Role.LEADER:
+            raise err.UnrecognizedTask(task_id)
+        self._check_collector_auth(task, auth)
+        try:
+            req = CollectionReq.decode(body)
+        except Exception as e:
+            raise err.InvalidMessage(f"malformed request: {e}", task_id) from e
+        if req.query.query_type is not task.query_type.query_type:
+            raise err.InvalidMessage("query type mismatch", task_id)
+
+        def txn(tx):
+            ident = ta.logic.collection_identifier_for_query(tx, task, req.query)
+            if ident is None:
+                raise err.BatchInvalid("no batch available for query", task_id)
+            if not ta.logic.validate_collection_identifier(task, ident):
+                raise err.BatchInvalid("misaligned collection interval", task_id)
+            existing = tx.get_collection_job(task_id, job_id)
+            if existing is not None:
+                if (existing.query.encode() != req.query.encode()
+                        or existing.aggregation_parameter
+                        != req.aggregation_parameter):
+                    raise err.ForbiddenMutation(
+                        f"collection job {job_id}", task_id)
+                return  # idempotent create
+            if not ta.logic.validate_query_count(
+                    tx, task, ident, self.cfg.max_batch_query_count):
+                raise err.BatchQueriedTooManyTimes("query count exceeded", task_id)
+            tx.put_batch_query(task_id, ident, req.aggregation_parameter)
+            tx.put_collection_job(m.CollectionJob(
+                task_id=task_id, id=job_id, query=req.query,
+                aggregation_parameter=req.aggregation_parameter,
+                batch_identifier=ident,
+                state=m.CollectionJobState.START,
+            ))
+
+        self.datastore.run_tx("create_collection_job", txn)
+
+    def handle_get_collection_job(self, task_id: TaskId, job_id: CollectionJobId,
+                                  auth: AuthenticationToken | None) -> bytes | None:
+        """Returns the encoded Collection when finished, None for 202."""
+        ta = self.task_aggregator(task_id)
+        task = ta.task
+        self._check_collector_auth(task, auth)
+
+        job = self.datastore.run_tx(
+            "get_collection_job", lambda tx: tx.get_collection_job(task_id, job_id))
+        if job is None:
+            raise err.UnrecognizedCollectionJob(job_id)
+        if job.state is m.CollectionJobState.START:
+            return None
+        if job.state is m.CollectionJobState.DELETED:
+            raise err.DeletedCollectionJob(job_id)
+        if job.state is m.CollectionJobState.ABANDONED:
+            raise err.InternalError("collection job abandoned")
+
+        # Encrypt the leader share to the collector at poll time
+        # (reference aggregator.rs:2536).
+        batch_selector = BatchSelector(task.query_type.query_type,
+                                       job.batch_identifier)
+        aad = AggregateShareAad(task_id, job.aggregation_parameter,
+                                batch_selector).encode()
+        leader_enc = hpke.seal(
+            task.collector_hpke_config,
+            hpke.application_info(hpke.Label.AGGREGATE_SHARE, Role.LEADER,
+                                  Role.COLLECTOR),
+            job.leader_aggregate_share, aad)
+        return Collection(
+            partial_batch_selector=PartialBatchSelector(
+                task.query_type.query_type,
+                ta.logic.downgrade_identifier(job.batch_identifier)),
+            report_count=job.report_count,
+            interval=job.client_timestamp_interval,
+            leader_encrypted_agg_share=leader_enc,
+            helper_encrypted_agg_share=job.helper_encrypted_aggregate_share,
+        ).encode()
+
+    def handle_delete_collection_job(self, task_id: TaskId,
+                                     job_id: CollectionJobId,
+                                     auth: AuthenticationToken | None) -> None:
+        ta = self.task_aggregator(task_id)
+        self._check_collector_auth(ta.task, auth)
+
+        def txn(tx):
+            job = tx.get_collection_job(task_id, job_id)
+            if job is None:
+                raise err.UnrecognizedCollectionJob(job_id)
+            tx.update_collection_job(job.with_state(m.CollectionJobState.DELETED))
+
+        self.datastore.run_tx("delete_collection_job", txn)
+
+    # -- helper aggregate-share (reference aggregator.rs:2731) ------------
+
+    def handle_aggregate_share(self, task_id: TaskId, body: bytes,
+                               auth: AuthenticationToken | None) -> bytes:
+        ta = self.task_aggregator(task_id)
+        task = ta.task
+        if task.role is not Role.HELPER:
+            raise err.UnrecognizedTask(task_id)
+        self._check_aggregator_auth(task, auth)
+        try:
+            req = AggregateShareReq.decode(body)
+        except Exception as e:
+            raise err.InvalidMessage(f"malformed request: {e}", task_id) from e
+        if req.batch_selector.query_type is not task.query_type.query_type:
+            raise err.InvalidMessage("query type mismatch", task_id)
+        ident = req.batch_selector.batch_identifier
+        if not ta.logic.validate_collection_identifier(task, ident):
+            raise err.BatchInvalid("misaligned batch interval", task_id)
+
+        def txn(tx):
+            # Idempotency: a cached AggregateShareJob is re-served
+            # (reference aggregator.rs:2859).
+            existing = tx.get_aggregate_share_job(
+                task_id, ident, req.aggregation_parameter)
+            if existing is not None:
+                if (existing.report_count != req.report_count
+                        or bytes(existing.checksum) != bytes(req.checksum)):
+                    raise err.BatchMismatch(
+                        "repeated aggregate-share request with different "
+                        "report count or checksum", task_id)
+                return existing
+            if not ta.logic.validate_query_count(
+                    tx, task, ident, self.cfg.max_batch_query_count):
+                raise err.BatchQueriedTooManyTimes("query count exceeded", task_id)
+
+            shards = []
+            for batch_ident in ta.logic.batch_identifiers_for_collection_identifier(
+                    task, ident):
+                shards.extend(tx.get_batch_aggregations(
+                    task_id, batch_ident, req.aggregation_parameter))
+            share, count, checksum, _interval = merge_batch_aggregations(
+                ta.vdaf, shards)
+            if count < task.min_batch_size:
+                raise err.InvalidBatchSize(
+                    f"batch has {count} reports, minimum is "
+                    f"{task.min_batch_size}", task_id)
+            if count != req.report_count or bytes(checksum) != bytes(req.checksum):
+                raise err.BatchMismatch(
+                    f"leader claimed {req.report_count} reports with checksum "
+                    f"{bytes(req.checksum).hex()}; helper computed {count} "
+                    f"with {bytes(checksum).hex()}", task_id)
+            asj = m.AggregateShareJob(
+                task_id=task_id, batch_identifier=ident,
+                aggregation_parameter=req.aggregation_parameter,
+                helper_aggregate_share=ta.vdaf.encode_agg_share(share),
+                report_count=count, checksum=checksum,
+            )
+            tx.put_batch_query(task_id, ident, req.aggregation_parameter)
+            tx.put_aggregate_share_job(asj)
+            return asj
+
+        asj = self.datastore.run_tx("aggregate_share", txn)
+
+        aad = AggregateShareAad(task_id, req.aggregation_parameter,
+                                req.batch_selector).encode()
+        encrypted = hpke.seal(
+            task.collector_hpke_config,
+            hpke.application_info(hpke.Label.AGGREGATE_SHARE, Role.HELPER,
+                                  Role.COLLECTOR),
+            asj.helper_aggregate_share, aad)
+        return AggregateShare(encrypted).encode()
+
+
+def merge_batch_aggregations(vdaf, shards: list[m.BatchAggregation]):
+    """compute_aggregate_share: merge shard accumulators into
+    (share, report_count, checksum, interval) (reference aggregate_share.rs:21)."""
+    from janus_tpu.messages import ReportIdChecksum
+
+    share = None
+    count = 0
+    checksum = ReportIdChecksum.zero()
+    interval = None
+    for ba in shards:
+        count += ba.report_count
+        checksum = checksum.combined(ba.checksum)
+        if ba.aggregate_share is not None:
+            part = vdaf.decode_agg_share(ba.aggregate_share)
+            share = part if share is None else vdaf.aggregate_update(share, part)
+        if ba.report_count or ba.aggregate_share is not None:
+            interval = (ba.client_timestamp_interval if interval is None
+                        else Interval.spanning(interval,
+                                               ba.client_timestamp_interval))
+    if share is None:
+        share = vdaf.aggregate_init()
+    return share, count, checksum, interval

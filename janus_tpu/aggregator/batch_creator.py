@@ -1,0 +1,65 @@
+"""Fixed-size batch filling (reference aggregator/src/aggregator/batch_creator.rs:32).
+
+Greedily assigns newly claimed reports to `OutstandingBatch`es with the most
+remaining capacity toward `max_batch_size`, creating new batches as needed,
+optionally bucketing by report time (`batch_time_window_size`).  Runs inside
+the creator's transaction.
+"""
+
+from __future__ import annotations
+
+from janus_tpu.datastore import models as m
+from janus_tpu.messages import BatchId, Time
+
+
+class BatchCreator:
+    def __init__(self, task, min_aggregation_job_size: int,
+                 max_aggregation_job_size: int):
+        self.task = task
+        self.min_job = min_aggregation_job_size
+        self.max_job = max_aggregation_job_size
+
+    def _time_bucket(self, t: Time) -> Time | None:
+        window = self.task.query_type.batch_time_window_size
+        if window is None:
+            return None
+        return t.round_down(window)
+
+    def assign(self, tx, reports: list[tuple]) -> dict[BatchId, list[tuple]]:
+        """reports: [(ReportId, Time)] -> assignment batch_id -> reports.
+
+        Creates/updates outstanding_batches rows; caller creates the
+        aggregation jobs per batch."""
+        max_batch = self.task.query_type.max_batch_size
+        by_bucket: dict[Time | None, list[tuple]] = {}
+        for rid, t in reports:
+            by_bucket.setdefault(self._time_bucket(t), []).append((rid, t))
+
+        assignment: dict[BatchId, list[tuple]] = {}
+        for bucket, rs in by_bucket.items():
+            outstanding = tx.get_outstanding_batches(self.task.task_id, bucket)
+            # fill by most-remaining-capacity first (reference :158)
+            open_batches = [
+                [batch.id, max_batch - filled if max_batch else len(rs), batch]
+                for batch, filled in outstanding
+                if max_batch is None or filled < max_batch
+            ]
+            open_batches.sort(key=lambda e: -e[1])
+            idx = 0
+            while idx < len(rs):
+                if open_batches and open_batches[0][1] > 0:
+                    take = min(open_batches[0][1], len(rs) - idx)
+                    bid = open_batches[0][0]
+                    assignment.setdefault(bid, []).extend(rs[idx : idx + take])
+                    tx.add_to_outstanding_batch(self.task.task_id, bid, take)
+                    open_batches[0][1] -= take
+                    open_batches.sort(key=lambda e: -e[1])
+                    idx += take
+                else:
+                    bid = BatchId.random()
+                    tx.put_outstanding_batch(m.OutstandingBatch(
+                        self.task.task_id, bid, bucket))
+                    cap = max_batch if max_batch is not None else len(rs) - idx
+                    open_batches.append([bid, cap, None])
+                    open_batches.sort(key=lambda e: -e[1])
+        return assignment

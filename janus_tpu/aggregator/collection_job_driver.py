@@ -1,0 +1,179 @@
+"""Leader daemon: drive collection jobs to completion
+(reference aggregator/src/aggregator/collection_job_driver.rs:45).
+
+Per leased job: readiness gate (every touched batch's
+aggregation_jobs_created == aggregation_jobs_terminated and no unaggregated
+reports remain in the interval — reference :240-265), mark batches
+COLLECTED, merge the shard accumulators into the leader aggregate share
+(+ DP noise hook), POST aggregate_shares to the helper with our
+count/checksum claim, store the finished job, scrub the shards."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from janus_tpu.aggregator.aggregator import merge_batch_aggregations
+from janus_tpu.aggregator.http_client import PeerClient, PeerHttpError
+from janus_tpu.aggregator.query_type import logic_for
+from janus_tpu.core.dp import NoDifferentialPrivacy
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import Datastore
+from janus_tpu.messages import (
+    AggregateShare,
+    AggregateShareReq,
+    BatchSelector,
+    Duration,
+    Interval,
+    Time,
+)
+from janus_tpu.models.vdaf_instance import prep_engine
+
+
+class CollectionJobDriver:
+    def __init__(self, datastore: Datastore, peer_client: PeerClient | None = None,
+                 maximum_attempts_before_failure: int = 10,
+                 lease_duration_s: int = 600,
+                 retry_delay_s: int = 30,
+                 dp_strategy=None):
+        self.datastore = datastore
+        self.peer = peer_client or PeerClient()
+        self.max_attempts = maximum_attempts_before_failure
+        self.lease_duration = Duration(lease_duration_s)
+        self.retry_delay = Duration(retry_delay_s)
+        self.dp_strategy = dp_strategy or NoDifferentialPrivacy()
+
+    # -- JobDriver callbacks ----------------------------------------------
+
+    def acquirer(self, limit: int):
+        return self.datastore.run_tx(
+            "acquire_coll_jobs",
+            lambda tx: tx.acquire_incomplete_collection_jobs(
+                self.lease_duration, limit))
+
+    def stepper(self, lease: m.Lease) -> None:
+        if lease.lease_attempts > self.max_attempts:
+            self.abandon_collection_job(lease)
+            return
+        try:
+            self.step_collection_job(lease)
+        except PeerHttpError:
+            self._release(lease, self.retry_delay)
+            raise
+
+    # -- stepping (reference :93,126) --------------------------------------
+
+    def step_collection_job(self, lease: m.Lease) -> None:
+        acquired: m.AcquiredCollectionJob = lease.leased
+        task_id = acquired.task_id
+        job_id = acquired.collection_job_id
+
+        def load(tx):
+            task = tx.get_aggregator_task(task_id)
+            job = tx.get_collection_job(task_id, job_id)
+            return task, job
+
+        task, job = self.datastore.run_tx("step_coll_job_load", load)
+        if task is None or job is None or job.state is not m.CollectionJobState.START:
+            self._release(lease, None)
+            return
+
+        engine = prep_engine(task.vdaf)
+        vdaf = engine.vdaf
+        logic = logic_for(task.query_type.query_type)
+        batch_identifiers = logic.batch_identifiers_for_collection_identifier(
+            task, job.batch_identifier)
+
+        # tx1: readiness gate + mark COLLECTED (reference :240-305).
+        def gate(tx):
+            shards = []
+            for ident in batch_identifiers:
+                shards.extend(tx.get_batch_aggregations(
+                    task_id, ident, job.aggregation_parameter))
+            # Readiness: per batch, the SUM of created across shards equals
+            # the SUM of terminated (increments land on random shards).
+            created: dict[bytes, int] = {}
+            terminated: dict[bytes, int] = {}
+            for ba in shards:
+                key = m.encode_batch_identifier(ba.batch_identifier)
+                created[key] = created.get(key, 0) + ba.aggregation_jobs_created
+                terminated[key] = (terminated.get(key, 0)
+                                   + ba.aggregation_jobs_terminated)
+            if any(created[k] != terminated.get(k, 0) for k in created):
+                return None
+            interval = logic.to_batch_interval(job.batch_identifier)
+            if interval is not None:
+                if tx.count_unaggregated_reports_in_interval(task_id, interval):
+                    return None
+            for ba in shards:
+                if ba.state is m.BatchAggregationState.AGGREGATING:
+                    tx.update_batch_aggregation(
+                        replace(ba, state=m.BatchAggregationState.COLLECTED))
+            return shards
+
+        shards = self.datastore.run_tx("coll_job_gate", gate)
+        if shards is None:
+            self._release(lease, self.retry_delay)
+            return
+
+        share, count, checksum, interval = merge_batch_aggregations(vdaf, shards)
+        if interval is None:
+            interval = (logic.to_batch_interval(job.batch_identifier)
+                        or Interval(Time(0), Duration(1)))
+        share = self.dp_strategy.add_noise_to_agg_share(vdaf, share, count)
+
+        # Helper exchange (process boundary).
+        req = AggregateShareReq(
+            batch_selector=BatchSelector(task.query_type.query_type,
+                                         job.batch_identifier),
+            aggregation_parameter=job.aggregation_parameter,
+            report_count=count,
+            checksum=checksum,
+        )
+        result = self.peer.send_to_helper(
+            task, "POST", f"tasks/{task.task_id}/aggregate_shares",
+            req.encode(), AggregateShareReq.MEDIA_TYPE)
+        helper_share = AggregateShare.decode(result.body)
+
+        # tx2: finish + scrub (reference :381-446).
+        def finish(tx):
+            current = tx.get_collection_job(task_id, job_id)
+            if current is None or current.state is not m.CollectionJobState.START:
+                return
+            done = m.CollectionJob(
+                task_id=task_id, id=job_id, query=job.query,
+                aggregation_parameter=job.aggregation_parameter,
+                batch_identifier=job.batch_identifier,
+                state=m.CollectionJobState.FINISHED,
+                report_count=count,
+                client_timestamp_interval=interval,
+                leader_aggregate_share=vdaf.encode_agg_share(share),
+                helper_encrypted_aggregate_share=helper_share.encrypted_aggregate_share,
+            )
+            tx.update_collection_job(done)
+            for ba in shards:
+                tx.update_batch_aggregation(replace(
+                    ba, state=m.BatchAggregationState.SCRUBBED,
+                    aggregate_share=None))
+            tx.release_collection_job(lease)
+
+        self.datastore.run_tx("coll_job_finish", finish)
+
+    def abandon_collection_job(self, lease: m.Lease) -> None:
+        def txn(tx):
+            job = tx.get_collection_job(lease.leased.task_id,
+                                        lease.leased.collection_job_id)
+            if job is not None and job.state is m.CollectionJobState.START:
+                tx.update_collection_job(
+                    job.with_state(m.CollectionJobState.ABANDONED))
+            tx.release_collection_job(lease)
+
+        self.datastore.run_tx("abandon_coll_job", txn)
+
+    def _release(self, lease: m.Lease, delay: Duration | None) -> None:
+        def txn(tx):
+            try:
+                tx.release_collection_job(lease, delay)
+            except Exception:
+                pass
+
+        self.datastore.run_tx("release_coll_job", txn)

@@ -1,0 +1,182 @@
+"""Aggregator error taxonomy + RFC-7807 problem-details mapping
+(reference aggregator/src/error.rs:24, problem_details.rs)."""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass
+
+from janus_tpu.messages import AggregationJobId, CollectionJobId, ReportId, TaskId, Time
+from janus_tpu.messages.problem_type import DapProblemType
+
+
+class ReportRejectionReason(str, enum.Enum):
+    """Why an upload was turned away (reference error.rs:220)."""
+
+    INTERVAL_COLLECTED = "intervalCollected"
+    DECRYPT_FAILURE = "decryptFailure"
+    DECODE_FAILURE = "decodeFailure"
+    TASK_EXPIRED = "taskExpired"
+    EXPIRED = "expired"
+    TOO_EARLY = "tooEarly"
+    OUTDATED_HPKE_CONFIG = "outdatedHpkeConfig"
+
+    def problem_type(self) -> DapProblemType:
+        if self is ReportRejectionReason.TOO_EARLY:
+            return DapProblemType.REPORT_TOO_EARLY
+        if self is ReportRejectionReason.OUTDATED_HPKE_CONFIG:
+            return DapProblemType.OUTDATED_CONFIG
+        return DapProblemType.REPORT_REJECTED
+
+    def detail(self) -> str:
+        return {
+            ReportRejectionReason.INTERVAL_COLLECTED:
+                "Report falls into a time interval that has already been collected.",
+            ReportRejectionReason.DECRYPT_FAILURE: "Report share could not be decrypted.",
+            ReportRejectionReason.DECODE_FAILURE: "Report could not be decoded.",
+            ReportRejectionReason.TASK_EXPIRED: "Task has expired.",
+            ReportRejectionReason.EXPIRED: "Report timestamp is too old.",
+            ReportRejectionReason.TOO_EARLY: "Report timestamp is too far in the future.",
+            ReportRejectionReason.OUTDATED_HPKE_CONFIG:
+                "Report is using an outdated HPKE configuration.",
+        }[self]
+
+
+@dataclass
+class ReportRejection:
+    task_id: TaskId
+    report_id: ReportId
+    time: Time
+    reason: ReportRejectionReason
+
+
+class AggregatorError(Exception):
+    """Base class; subclasses know their DAP problem type + HTTP status."""
+
+    problem: DapProblemType | None = None
+    status: int = 500
+
+    def __init__(self, detail: str = "", task_id: TaskId | None = None):
+        super().__init__(detail)
+        self.detail = detail
+        self.task_id = task_id
+
+    def problem_document(self) -> tuple[int, dict]:
+        status = self.problem.http_status() if self.problem else self.status
+        doc = {
+            "status": status,
+            "detail": self.detail or str(self),
+        }
+        if self.problem is not None:
+            doc["type"] = self.problem.type_uri
+            doc["title"] = self.problem.value
+        if self.task_id is not None:
+            doc["taskid"] = str(self.task_id)
+        return status, doc
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.problem_document()[1]).encode()
+
+
+class InvalidMessage(AggregatorError):
+    problem = DapProblemType.INVALID_MESSAGE
+
+
+class UnrecognizedTask(AggregatorError):
+    problem = DapProblemType.UNRECOGNIZED_TASK
+    status = 400
+
+    def __init__(self, task_id: TaskId):
+        super().__init__(f"unrecognized task {task_id}", task_id)
+
+
+class MissingTaskId(AggregatorError):
+    problem = DapProblemType.MISSING_TASK_ID
+
+
+class UnrecognizedAggregationJob(AggregatorError):
+    problem = DapProblemType.UNRECOGNIZED_AGGREGATION_JOB
+    status = 404
+
+    def __init__(self, task_id: TaskId, job_id: AggregationJobId):
+        super().__init__(f"unrecognized aggregation job {job_id}", task_id)
+        self.job_id = job_id
+
+
+class DeletedAggregationJob(AggregatorError):
+    status = 410
+
+    def __init__(self, task_id: TaskId, job_id: AggregationJobId):
+        super().__init__(f"deleted aggregation job {job_id}", task_id)
+
+
+class UnrecognizedCollectionJob(AggregatorError):
+    problem = DapProblemType.UNRECOGNIZED_COLLECTION_JOB
+    status = 404
+
+    def __init__(self, job_id: CollectionJobId):
+        super().__init__(f"unrecognized collection job {job_id}")
+
+
+class DeletedCollectionJob(AggregatorError):
+    status = 204
+
+    def __init__(self, job_id: CollectionJobId):
+        super().__init__(f"deleted collection job {job_id}")
+
+
+class OutdatedHpkeConfig(AggregatorError):
+    problem = DapProblemType.OUTDATED_CONFIG
+
+
+class ReportRejected(AggregatorError):
+    def __init__(self, rejection: ReportRejection):
+        super().__init__(rejection.reason.detail(), rejection.task_id)
+        self.rejection = rejection
+        self.problem = rejection.reason.problem_type()
+
+
+class UnauthorizedRequest(AggregatorError):
+    problem = DapProblemType.UNAUTHORIZED_REQUEST
+
+
+class InvalidBatchSize(AggregatorError):
+    problem = DapProblemType.INVALID_BATCH_SIZE
+
+
+class BatchInvalid(AggregatorError):
+    problem = DapProblemType.BATCH_INVALID
+
+
+class BatchOverlap(AggregatorError):
+    problem = DapProblemType.BATCH_OVERLAP
+
+
+class BatchMismatch(AggregatorError):
+    problem = DapProblemType.BATCH_MISMATCH
+
+
+class BatchQueriedTooManyTimes(AggregatorError):
+    problem = DapProblemType.BATCH_QUERIED_TOO_MANY_TIMES
+
+
+class StepMismatch(AggregatorError):
+    problem = DapProblemType.STEP_MISMATCH
+
+
+class ForbiddenMutation(AggregatorError):
+    """Idempotent-resource conflict: same id, different content."""
+
+    status = 409
+
+
+class EmptyAggregation(AggregatorError):
+    problem = DapProblemType.INVALID_MESSAGE
+
+    def __init__(self, task_id: TaskId):
+        super().__init__("aggregation job contains no report shares", task_id)
+
+
+class InternalError(AggregatorError):
+    status = 500

@@ -1,0 +1,48 @@
+"""Expired-artifact deletion (reference aggregator/src/aggregator/garbage_collector.rs:14).
+
+Per task with a report_expiry_age: delete expired client reports,
+aggregation artifacts (jobs + report aggregations), and collection
+artifacts (collection jobs, aggregate-share jobs, batch aggregations,
+outstanding batches), with per-call row limits to bound transaction size.
+"""
+
+from __future__ import annotations
+
+from janus_tpu.datastore.datastore import Datastore
+
+
+class GarbageCollector:
+    def __init__(self, datastore: Datastore,
+                 report_limit: int = 5000,
+                 aggregation_limit: int = 10000,
+                 collection_limit: int = 10000):
+        self.datastore = datastore
+        self.report_limit = report_limit
+        self.aggregation_limit = aggregation_limit
+        self.collection_limit = collection_limit
+
+    def run_once(self) -> dict:
+        """GC every task once; returns per-kind deletion counts."""
+        tasks = self.datastore.run_tx(
+            "gc_get_tasks", lambda tx: tx.get_aggregator_tasks())
+        totals = {"reports": 0, "aggregation": 0, "collection": 0}
+        for task in tasks:
+            if task.report_expiry_age is None:
+                continue
+            counts = self.gc_task(task)
+            for k in totals:
+                totals[k] += counts[k]
+        return totals
+
+    def gc_task(self, task) -> dict:
+        def txn(tx):
+            return {
+                "reports": tx.delete_expired_client_reports(
+                    task.task_id, task.report_expiry_age, self.report_limit),
+                "aggregation": tx.delete_expired_aggregation_artifacts(
+                    task.task_id, task.report_expiry_age, self.aggregation_limit),
+                "collection": tx.delete_expired_collection_artifacts(
+                    task.task_id, task.report_expiry_age, self.collection_limit),
+            }
+
+        return self.datastore.run_tx("gc_task", txn)

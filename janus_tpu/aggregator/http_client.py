@@ -1,0 +1,49 @@
+"""Leader->helper HTTP client with retries and auth
+(reference aggregator.rs:3086 send_request_to_helper)."""
+
+from __future__ import annotations
+
+from janus_tpu.core.retries import Backoff, HttpResult, retry_http_request
+from janus_tpu.datastore.task import AggregatorTask
+
+
+class PeerHttpError(Exception):
+    def __init__(self, status: int, body: bytes):
+        super().__init__(f"helper returned {status}: {body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+class PeerClient:
+    def __init__(self, session=None, backoff: Backoff | None = None):
+        if session is None:
+            import requests
+
+            session = requests.Session()
+        self.session = session
+        self.backoff = backoff
+
+    def send_to_helper(self, task: AggregatorTask, method: str, path: str,
+                       body: bytes, content_type: str) -> HttpResult:
+        """PUT/POST `path` (relative) on the task's peer endpoint; retries
+        retryable statuses / connection failures with backoff; raises
+        PeerHttpError on a final non-2xx."""
+        url = task.peer_aggregator_endpoint.rstrip("/") + "/" + path.lstrip("/")
+        headers = {"Content-Type": content_type}
+        if task.aggregator_auth_token is not None:
+            headers.update(task.aggregator_auth_token.request_headers())
+
+        def attempt() -> HttpResult:
+            try:
+                resp = self.session.request(method, url, data=body,
+                                            headers=headers, timeout=30)
+            except OSError:
+                raise
+            except Exception as e:  # requests wraps connection errors
+                raise OSError(str(e)) from e
+            return HttpResult(resp.status_code, dict(resp.headers), resp.content)
+
+        result = retry_http_request(attempt, self.backoff)
+        if not 200 <= result.status < 300:
+            raise PeerHttpError(result.status, result.body)
+        return result

@@ -1,0 +1,239 @@
+"""DAP HTTP surface (reference aggregator/src/aggregator/http_handlers.rs:281).
+
+Routes (draft-ietf-ppm-dap-09):
+    GET    /hpke_config?task_id=...
+    PUT    /tasks/{task_id}/reports
+    PUT    /tasks/{task_id}/aggregation_jobs/{aggregation_job_id}
+    POST   /tasks/{task_id}/aggregation_jobs/{aggregation_job_id}
+    DELETE /tasks/{task_id}/aggregation_jobs/{aggregation_job_id}
+    PUT    /tasks/{task_id}/collection_jobs/{collection_job_id}
+    POST   /tasks/{task_id}/collection_jobs/{collection_job_id}
+    DELETE /tasks/{task_id}/collection_jobs/{collection_job_id}
+    POST   /tasks/{task_id}/aggregate_shares
+
+Errors map to RFC-7807 problem documents (http_handlers.rs:42).  The server
+is a stdlib ThreadingHTTPServer — the process boundary; all protocol logic
+lives in aggregator.Aggregator.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from janus_tpu.aggregator import error as err
+from janus_tpu.aggregator.aggregator import Aggregator
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.messages import (
+    AggregateShare,
+    AggregationJobId,
+    AggregationJobResp,
+    Collection,
+    CollectionJobId,
+    HpkeConfigList,
+    Report,
+    TaskId,
+)
+
+PROBLEM_JSON = "application/problem+json"
+
+_ROUTES = [
+    ("GET", re.compile(r"^/hpke_config$"), "hpke_config"),
+    ("PUT", re.compile(r"^/tasks/([^/]+)/reports$"), "upload"),
+    ("PUT", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "agg_init"),
+    ("POST", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "agg_cont"),
+    ("DELETE", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "agg_del"),
+    ("PUT", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "coll_put"),
+    ("POST", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "coll_poll"),
+    ("DELETE", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "coll_del"),
+    ("POST", re.compile(r"^/tasks/([^/]+)/aggregate_shares$"), "agg_share"),
+]
+
+
+def _parse_auth(headers) -> AuthenticationToken | None:
+    """DAP-Auth-Token header or Bearer authorization."""
+    dap = headers.get("DAP-Auth-Token")
+    if dap is not None:
+        return AuthenticationToken.dap_auth(dap)
+    authz = headers.get("Authorization")
+    if authz is not None and authz.startswith("Bearer "):
+        return AuthenticationToken.bearer(authz[len("Bearer "):])
+    return None
+
+
+class _Response:
+    def __init__(self, status: int, body: bytes = b"",
+                 content_type: str | None = None, headers: dict | None = None):
+        self.status = status
+        self.body = body
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
+class DapRouter:
+    """Transport-independent request dispatcher; used by the HTTP server and
+    driven directly by in-process tests (the trillium_testing analog)."""
+
+    def __init__(self, aggregator: Aggregator):
+        self.aggregator = aggregator
+
+    def handle(self, method: str, path: str, query: dict, body: bytes,
+               headers) -> _Response:
+        try:
+            for m_, rx, name in _ROUTES:
+                if m_ != method:
+                    continue
+                match = rx.match(path)
+                if match:
+                    return getattr(self, "_" + name)(match, query, body, headers)
+            return _Response(404, json.dumps({
+                "status": 404, "detail": "no such route"}).encode(), PROBLEM_JSON)
+        except err.AggregatorError as e:
+            status, doc = e.problem_document()
+            if status == 204:
+                return _Response(204)
+            return _Response(status, json.dumps(doc).encode(), PROBLEM_JSON)
+        except Exception:
+            traceback.print_exc()
+            return _Response(500, json.dumps({
+                "status": 500, "detail": "internal error"}).encode(), PROBLEM_JSON)
+
+    # -- route handlers ----------------------------------------------------
+
+    def _hpke_config(self, match, query, body, headers) -> _Response:
+        task_id = None
+        if "task_id" in query:
+            task_id = TaskId.from_str(query["task_id"][0])
+        data = self.aggregator.handle_hpke_config(task_id)
+        return _Response(200, data, HpkeConfigList.MEDIA_TYPE,
+                         {"Cache-Control": "max-age=86400"})
+
+    def _upload(self, match, query, body, headers) -> _Response:
+        self._check_content_type(headers, Report.MEDIA_TYPE)
+        task_id = TaskId.from_str(match.group(1))
+        self.aggregator.handle_upload(task_id, body)
+        return _Response(201)
+
+    def _agg_init(self, match, query, body, headers) -> _Response:
+        task_id = TaskId.from_str(match.group(1))
+        job_id = AggregationJobId.from_str(match.group(2))
+        data = self.aggregator.handle_aggregate_init(
+            task_id, job_id, body, _parse_auth(headers))
+        return _Response(200, data, AggregationJobResp.MEDIA_TYPE)
+
+    def _agg_cont(self, match, query, body, headers) -> _Response:
+        task_id = TaskId.from_str(match.group(1))
+        job_id = AggregationJobId.from_str(match.group(2))
+        data = self.aggregator.handle_aggregate_continue(
+            task_id, job_id, body, _parse_auth(headers))
+        return _Response(200, data, AggregationJobResp.MEDIA_TYPE)
+
+    def _agg_del(self, match, query, body, headers) -> _Response:
+        task_id = TaskId.from_str(match.group(1))
+        job_id = AggregationJobId.from_str(match.group(2))
+        self.aggregator.handle_aggregate_delete(task_id, job_id,
+                                                _parse_auth(headers))
+        return _Response(204)
+
+    def _coll_put(self, match, query, body, headers) -> _Response:
+        task_id = TaskId.from_str(match.group(1))
+        job_id = CollectionJobId.from_str(match.group(2))
+        self.aggregator.handle_create_collection_job(
+            task_id, job_id, body, _parse_auth(headers))
+        return _Response(201)
+
+    def _coll_poll(self, match, query, body, headers) -> _Response:
+        task_id = TaskId.from_str(match.group(1))
+        job_id = CollectionJobId.from_str(match.group(2))
+        data = self.aggregator.handle_get_collection_job(
+            task_id, job_id, _parse_auth(headers))
+        if data is None:
+            return _Response(202, headers={"Retry-After": "60"})
+        return _Response(200, data, Collection.MEDIA_TYPE)
+
+    def _coll_del(self, match, query, body, headers) -> _Response:
+        task_id = TaskId.from_str(match.group(1))
+        job_id = CollectionJobId.from_str(match.group(2))
+        self.aggregator.handle_delete_collection_job(task_id, job_id,
+                                                     _parse_auth(headers))
+        return _Response(204)
+
+    def _agg_share(self, match, query, body, headers) -> _Response:
+        task_id = TaskId.from_str(match.group(1))
+        data = self.aggregator.handle_aggregate_share(
+            task_id, body, _parse_auth(headers))
+        return _Response(200, data, AggregateShare.MEDIA_TYPE)
+
+    @staticmethod
+    def _check_content_type(headers, want: str) -> None:
+        got = headers.get("Content-Type")
+        if got is not None and got.split(";")[0].strip() != want:
+            raise err.InvalidMessage(f"unexpected content type {got}")
+
+
+class DapHttpServer:
+    """Threaded HTTP server wrapping a DapRouter (reference
+    binary_utils.rs:461 setup_server)."""
+
+    def __init__(self, aggregator: Aggregator, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = DapRouter(aggregator)
+        router = self.router
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _run(self, method: str):
+                parsed = urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                resp = router.handle(method, parsed.path,
+                                     parse_qs(parsed.query), body, self.headers)
+                self.send_response(resp.status)
+                if resp.content_type:
+                    self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(resp.body)))
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if resp.body:
+                    self.wfile.write(resp.body)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_PUT(self):
+                self._run("PUT")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "DapHttpServer":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,68 @@
+"""Generic lease-based work loop (reference binary_utils/job_driver.rs:26).
+
+Acquires leases through an `acquirer` callback, dispatches each to a
+`stepper` on a bounded worker pool, and re-discovers work every
+`job_discovery_interval`.  Failure detection is lease expiry: a crashed
+worker's lease times out and any replica re-acquires it (SURVEY.md §5.3).
+`run_once()` exposes a single synchronous discovery round for tests and for
+cron-style deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+
+@dataclass
+class JobDriverConfig:
+    """reference config.rs:164."""
+
+    job_discovery_interval_s: float = 10.0
+    max_concurrent_job_workers: int = 10
+    lease_duration_s: int = 600
+    maximum_attempts_before_failure: int = 10
+
+
+class JobDriver:
+    def __init__(self, cfg: JobDriverConfig, acquirer, stepper):
+        """acquirer(limit) -> list[Lease]; stepper(lease) -> None."""
+        self.cfg = cfg
+        self.acquirer = acquirer
+        self.stepper = stepper
+        self._stop = threading.Event()
+
+    def run_once(self) -> int:
+        """One discovery round: acquire up to the concurrency limit and step
+        every lease (synchronously, on the pool).  Returns #jobs stepped."""
+        leases = self.acquirer(self.cfg.max_concurrent_job_workers)
+        if not leases:
+            return 0
+        with ThreadPoolExecutor(self.cfg.max_concurrent_job_workers) as pool:
+            futures = [pool.submit(self._step, lease) for lease in leases]
+            for f in futures:
+                f.result()
+        return len(leases)
+
+    def _step(self, lease) -> None:
+        try:
+            self.stepper(lease)
+        except Exception:
+            # The lease simply expires; another replica will retry.
+            traceback.print_exc()
+
+    def run(self) -> None:
+        """Discovery loop until stop() (reference job_driver.rs:100)."""
+        while not self._stop.is_set():
+            try:
+                n = self.run_once()
+            except Exception:
+                traceback.print_exc()
+                n = 0
+            if n == 0:
+                self._stop.wait(self.cfg.job_discovery_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,171 @@
+"""Query-type batch mapping: the Accumulable / Collectable logic
+(reference aggregator_core/src/query_type.rs:20,178 and
+aggregator/src/aggregator/query_type.rs:20,93).
+
+Python-idiomatic: one strategy object per query type dispatched off the
+message-layer descriptors (TIME_INTERVAL / FIXED_SIZE) instead of the
+reference's compile-time generics.
+"""
+
+from __future__ import annotations
+
+from janus_tpu.datastore.task import AggregatorTask
+from janus_tpu.messages import (
+    FIXED_SIZE,
+    TIME_INTERVAL,
+    BatchId,
+    Duration,
+    FixedSizeQuery,
+    Interval,
+    Query,
+    Time,
+)
+
+
+class _TimeIntervalLogic:
+    descriptor = TIME_INTERVAL
+
+    # -- accumulable (maps reports into batches) -------------------------
+
+    def to_batch_identifier(self, task: AggregatorTask, partial_ident,
+                            client_timestamp: Time) -> Interval:
+        """A report belongs to the time-precision bucket containing it."""
+        return Interval(client_timestamp.round_down(task.time_precision),
+                        task.time_precision)
+
+    def default_partial_identifier(self):
+        return None  # unit: always known for time-interval
+
+    def upgrade_partial_identifier(self, partial_ident):
+        return None
+
+    def downgrade_identifier(self, batch_identifier: Interval):
+        return None
+
+    def to_batch_interval(self, batch_identifier: Interval) -> Interval | None:
+        return batch_identifier
+
+    def is_batch_garbage_collected(self, clock, batch_identifier: Interval) -> bool | None:
+        return batch_identifier.end() < clock.now()
+
+    # -- collectable (maps collection queries onto batches) --------------
+
+    def collection_identifier_for_query(self, tx, task: AggregatorTask,
+                                        query: Query) -> Interval | None:
+        return query.query_body  # the batch interval, directly from the query
+
+    def batch_identifiers_for_collection_identifier(
+        self, task: AggregatorTask, collection_identifier: Interval
+    ) -> list[Interval]:
+        tp = task.time_precision.seconds
+        assert collection_identifier.duration.seconds % tp == 0
+        return [
+            Interval(Time(s), task.time_precision)
+            for s in range(collection_identifier.start.seconds,
+                           collection_identifier.end().seconds, tp)
+        ]
+
+    def validate_collection_identifier(self, task: AggregatorTask,
+                                       ident: Interval) -> bool:
+        """DAP batch-boundary checks (reference query_type.rs:270-283)."""
+        tp = task.time_precision.seconds
+        return (ident.duration.seconds >= tp
+                and ident.start.seconds % tp == 0
+                and ident.duration.seconds % tp == 0)
+
+    def count_client_reports(self, tx, task: AggregatorTask, ident: Interval) -> int:
+        return tx.count_client_reports_for_interval(task.task_id, ident)
+
+    def validate_query_count(self, tx, task: AggregatorTask, ident: Interval,
+                             max_batch_query_count: int = 1) -> bool:
+        """Leader-side: no other queries may overlap this interval
+        (reference aggregator/query_type.rs:93 + batch-overlap rule)."""
+        overlapping = tx.get_queried_batch_intervals_overlapping(task.task_id, ident)
+        for other in overlapping:
+            if other != ident:
+                return False  # overlapping but not identical -> batchOverlap
+        return tx.count_batch_queries(task.task_id, ident) < max_batch_query_count
+
+    # -- upload-side -----------------------------------------------------
+
+    def validate_uploaded_report(self, tx, task: AggregatorTask, report) -> bool:
+        """Reject reports whose interval was already collected
+        (reference aggregator/query_type.rs:20 UploadableQueryType)."""
+        interval = Interval(report.metadata.time.round_down(task.time_precision),
+                            task.time_precision)
+        for job in tx.get_collection_jobs_for_task(task.task_id):
+            ident = job.batch_identifier
+            if isinstance(ident, Interval) and ident.overlaps(interval) and \
+                    job.state.value in ("FINISHED", "START"):
+                return False
+        return True
+
+
+class _FixedSizeLogic:
+    descriptor = FIXED_SIZE
+
+    def to_batch_identifier(self, task: AggregatorTask, partial_ident: BatchId,
+                            client_timestamp: Time) -> BatchId:
+        return partial_ident
+
+    def default_partial_identifier(self):
+        return None  # must come from the request
+
+    def upgrade_partial_identifier(self, partial_ident: BatchId) -> BatchId:
+        return partial_ident
+
+    def downgrade_identifier(self, batch_identifier: BatchId) -> BatchId:
+        return batch_identifier
+
+    def to_batch_interval(self, batch_identifier: BatchId) -> Interval | None:
+        return None
+
+    def is_batch_garbage_collected(self, clock, batch_identifier) -> bool | None:
+        return None
+
+    def collection_identifier_for_query(self, tx, task: AggregatorTask,
+                                        query: Query) -> BatchId | None:
+        fsq: FixedSizeQuery = query.query_body
+        if fsq.kind == FixedSizeQuery.BY_BATCH_ID:
+            return fsq.batch_id
+        # CurrentBatch: pick a filled outstanding batch
+        return tx.acquire_filled_outstanding_batch(task.task_id, task.min_batch_size)
+
+    def batch_identifiers_for_collection_identifier(
+        self, task: AggregatorTask, collection_identifier: BatchId
+    ) -> list[BatchId]:
+        return [collection_identifier]
+
+    def validate_collection_identifier(self, task: AggregatorTask, ident) -> bool:
+        return True
+
+    def count_client_reports(self, tx, task: AggregatorTask, ident: BatchId) -> int:
+        return tx.count_client_reports_for_batch_id(task.task_id, ident)
+
+    def validate_query_count(self, tx, task: AggregatorTask, ident: BatchId,
+                             max_batch_query_count: int = 1) -> bool:
+        return tx.count_batch_queries(task.task_id, ident) < max_batch_query_count
+
+    def validate_uploaded_report(self, tx, task: AggregatorTask, report) -> bool:
+        return True  # fixed-size reports are not bound to time buckets
+
+
+TIME_INTERVAL_LOGIC = _TimeIntervalLogic()
+FIXED_SIZE_LOGIC = _FixedSizeLogic()
+
+
+def logic_for(descriptor):
+    """messages.QueryType descriptor -> strategy object."""
+    if descriptor is TIME_INTERVAL:
+        return TIME_INTERVAL_LOGIC
+    if descriptor is FIXED_SIZE:
+        return FIXED_SIZE_LOGIC
+    raise ValueError(f"unknown query type {descriptor!r}")
+
+
+def batch_interval_spanning(times: list[Time]) -> Interval:
+    """Minimal interval covering all client timestamps (reference
+    aggregator.rs:2016-2036: [min, max+1))."""
+    lo = min(times)
+    hi = max(times)
+    return Interval(lo, Duration(hi.seconds - lo.seconds + 1))

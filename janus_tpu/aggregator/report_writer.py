@@ -1,0 +1,113 @@
+"""Upload write coalescing (reference aggregator/src/aggregator/report_writer.rs:39).
+
+Buffers validated reports and flushes them into one transaction when the
+buffer reaches `max_batch_size` or `max_batch_write_delay` elapses —
+amortizing transaction overhead across uploads, and forming the natural
+device-batch boundary (SURVEY.md §P5).  Rejections are counted in the
+sharded task_upload_counters rows (reference report_writer.rs:326).
+
+Duplicate uploads conflict inside the flush transaction; conflicting
+duplicates are rejected per report without failing the rest of the batch.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import Datastore, MutationTargetAlreadyExists
+
+COUNTER_SHARDS = 8
+
+
+class ReportWriteBatcher:
+    def __init__(self, datastore: Datastore, max_batch_size: int = 100,
+                 max_batch_write_delay_ms: int = 250):
+        self.datastore = datastore
+        self.max_batch_size = max(1, max_batch_size)
+        self.max_batch_write_delay = max_batch_write_delay_ms / 1000.0
+        self._lock = threading.Lock()
+        self._buffer: list[tuple] = []  # (task, logic, report)
+        self._rejections: list = []
+        self._timer: threading.Timer | None = None
+
+    # -- public API --------------------------------------------------------
+
+    def write_report(self, task, logic, report: m.LeaderStoredReport) -> None:
+        with self._lock:
+            self._buffer.append((task, logic, report))
+            should_flush = (len(self._buffer) + len(self._rejections)
+                            >= self.max_batch_size)
+            if not should_flush and self._timer is None:
+                self._timer = threading.Timer(self.max_batch_write_delay,
+                                              self.flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if should_flush:
+            self.flush()
+
+    def write_rejection(self, rejection) -> None:
+        with self._lock:
+            self._rejections.append(rejection)
+            should_flush = (len(self._buffer) + len(self._rejections)
+                            >= self.max_batch_size)
+            if not should_flush and self._timer is None:
+                self._timer = threading.Timer(self.max_batch_write_delay,
+                                              self.flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if should_flush:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write everything buffered in one transaction."""
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            buffer, self._buffer = self._buffer, []
+            rejections, self._rejections = self._rejections, []
+        if not buffer and not rejections:
+            return
+
+        from janus_tpu.aggregator.error import ReportRejectionReason
+
+        def txn(tx):
+            success_by_task: dict[bytes, int] = {}
+            for task, logic, report in buffer:
+                key = bytes(task.task_id)
+                if not logic.validate_uploaded_report(tx, task, report):
+                    tx.increment_task_upload_counter(
+                        task.task_id, random.randrange(COUNTER_SHARDS),
+                        m.TaskUploadCounter(interval_collected=1))
+                    continue
+                try:
+                    tx.put_client_report(report)
+                except MutationTargetAlreadyExists:
+                    # Duplicate upload: drop silently unless content differs
+                    # (either way, not a batch-fatal event).
+                    continue
+                success_by_task[key] = success_by_task.get(key, 0) + 1
+            for task, _logic, _report in buffer:
+                key = bytes(task.task_id)
+                n = success_by_task.pop(key, 0)
+                if n:
+                    tx.increment_task_upload_counter(
+                        task.task_id, random.randrange(COUNTER_SHARDS),
+                        m.TaskUploadCounter(report_success=n))
+            counter_field = {
+                ReportRejectionReason.INTERVAL_COLLECTED: "interval_collected",
+                ReportRejectionReason.DECRYPT_FAILURE: "report_decrypt_failure",
+                ReportRejectionReason.DECODE_FAILURE: "report_decode_failure",
+                ReportRejectionReason.TASK_EXPIRED: "task_expired",
+                ReportRejectionReason.EXPIRED: "report_expired",
+                ReportRejectionReason.TOO_EARLY: "report_too_early",
+                ReportRejectionReason.OUTDATED_HPKE_CONFIG: "report_outdated_key",
+            }
+            for rejection in rejections:
+                tx.increment_task_upload_counter(
+                    rejection.task_id, random.randrange(COUNTER_SHARDS),
+                    m.TaskUploadCounter(**{counter_field[rejection.reason]: 1}))
+
+        self.datastore.run_tx("upload_flush", txn)

@@ -1,0 +1,122 @@
+"""DAP client SDK (reference client/src/lib.rs:186,270,390).
+
+Shards a measurement with the task's VDAF, HPKE-seals one input share to
+each aggregator, and uploads the Report to the leader.  This is the only
+place the client side of the VDAF (`shard`) is used in production code.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from janus_tpu.core import hpke
+from janus_tpu.core.time import Clock, RealClock
+from janus_tpu.messages import (
+    Duration,
+    HpkeConfig,
+    HpkeConfigList,
+    InputShareAad,
+    PlaintextInputShare,
+    Report,
+    ReportId,
+    ReportMetadata,
+    Role,
+    TaskId,
+)
+from janus_tpu.models import VdafInstance
+from janus_tpu.models.vdaf_instance import vdaf_for_instance
+
+
+class ClientError(Exception):
+    pass
+
+
+@dataclass
+class ClientParameters:
+    task_id: TaskId
+    leader_endpoint: str
+    helper_endpoint: str
+    time_precision: Duration
+
+
+class Client:
+    """reference client/src/lib.rs:270."""
+
+    def __init__(self, params: ClientParameters, vdaf_instance: VdafInstance,
+                 leader_hpke_config: HpkeConfig | None = None,
+                 helper_hpke_config: HpkeConfig | None = None,
+                 http_session=None, clock: Clock | None = None):
+        self.params = params
+        self.vdaf = vdaf_for_instance(vdaf_instance)
+        self.clock = clock or RealClock()
+        self._session = http_session
+        self.leader_hpke_config = leader_hpke_config
+        self.helper_hpke_config = helper_hpke_config
+
+    # -- HPKE config discovery (reference lib.rs:324) ----------------------
+
+    def _session_or_new(self):
+        if self._session is None:
+            import requests
+
+            self._session = requests.Session()
+        return self._session
+
+    def fetch_hpke_config(self, endpoint: str) -> HpkeConfig:
+        url = endpoint.rstrip("/") + "/hpke_config?task_id=" + str(self.params.task_id)
+        resp = self._session_or_new().get(url)
+        if resp.status_code != 200:
+            raise ClientError(f"hpke_config fetch failed: {resp.status_code}")
+        configs = HpkeConfigList.decode(resp.content).configs
+        for config in configs:
+            if hpke.is_hpke_config_supported(config):
+                return config
+        raise ClientError("no supported HPKE config")
+
+    def _ensure_configs(self):
+        if self.leader_hpke_config is None:
+            self.leader_hpke_config = self.fetch_hpke_config(
+                self.params.leader_endpoint)
+        if self.helper_hpke_config is None:
+            self.helper_hpke_config = self.fetch_hpke_config(
+                self.params.helper_endpoint)
+
+    # -- report preparation (reference lib.rs:390,424) ---------------------
+
+    def prepare_report(self, measurement, time=None) -> Report:
+        self._ensure_configs()
+        report_id = ReportId(os.urandom(ReportId.SIZE))
+        t = (time if time is not None else self.clock.now()).round_down(
+            self.params.time_precision)
+        metadata = ReportMetadata(report_id, t)
+        rand = os.urandom(self.vdaf.RAND_SIZE)
+        public_share, input_shares = self.vdaf.shard(
+            measurement, bytes(report_id), rand)
+        encoded_public = self.vdaf.encode_public_share(public_share)
+        aad = InputShareAad(self.params.task_id, metadata, encoded_public).encode()
+
+        encrypted = []
+        for role, config, share in (
+            (Role.LEADER, self.leader_hpke_config, input_shares[0]),
+            (Role.HELPER, self.helper_hpke_config, input_shares[1]),
+        ):
+            plaintext = PlaintextInputShare(
+                (), self.vdaf.encode_input_share(role.index(), share)).encode()
+            encrypted.append(hpke.seal(
+                config,
+                hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT, role),
+                plaintext, aad))
+        return Report(metadata, encoded_public, encrypted[0], encrypted[1])
+
+    def upload(self, measurement, time=None) -> Report:
+        report = self.prepare_report(measurement, time)
+        url = (self.params.leader_endpoint.rstrip("/")
+               + f"/tasks/{self.params.task_id}/reports")
+        resp = self._session_or_new().put(
+            url, data=report.encode(),
+            headers={"Content-Type": Report.MEDIA_TYPE})
+        if resp.status_code not in (200, 201):
+            raise ClientError(
+                f"upload failed: {resp.status_code} {resp.content[:200]!r}")
+        return report

@@ -1,0 +1,138 @@
+"""DAP collector SDK (reference collector/src/lib.rs:381,439,522,636).
+
+Drives PUT collection job -> poll (202/Retry-After) -> HPKE-open both
+aggregate shares -> vdaf.unshard -> aggregate result.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass
+
+from janus_tpu.core import hpke
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.messages import (
+    AggregateShareAad,
+    BatchSelector,
+    Collection,
+    CollectionJobId,
+    CollectionReq,
+    Interval,
+    Query,
+    Role,
+    TaskId,
+)
+from janus_tpu.models import VdafInstance
+from janus_tpu.models.vdaf_instance import vdaf_for_instance
+
+
+class CollectorError(Exception):
+    pass
+
+
+@dataclass
+class CollectionResult:
+    """reference collector/src/lib.rs:214."""
+
+    partial_batch_selector: object
+    report_count: int
+    interval: Interval
+    aggregate_result: object
+
+
+class Collector:
+    def __init__(self, task_id: TaskId, leader_endpoint: str,
+                 auth_token: AuthenticationToken, hpke_keypair: HpkeKeypair,
+                 vdaf_instance: VdafInstance, http_session=None):
+        self.task_id = task_id
+        self.leader_endpoint = leader_endpoint.rstrip("/")
+        self.auth_token = auth_token
+        self.hpke_keypair = hpke_keypair
+        self.vdaf = vdaf_for_instance(vdaf_instance)
+        if http_session is None:
+            import requests
+
+            http_session = requests.Session()
+        self.session = http_session
+
+    def _url(self, job_id: CollectionJobId) -> str:
+        return (f"{self.leader_endpoint}/tasks/{self.task_id}"
+                f"/collection_jobs/{job_id}")
+
+    # -- protocol steps ----------------------------------------------------
+
+    def start_collection(self, query: Query,
+                         aggregation_parameter: bytes = b"") -> CollectionJobId:
+        job_id = CollectionJobId.random()
+        req = CollectionReq(query, aggregation_parameter)
+        resp = self.session.put(
+            self._url(job_id), data=req.encode(),
+            headers={"Content-Type": CollectionReq.MEDIA_TYPE,
+                     **self.auth_token.request_headers()})
+        if resp.status_code not in (200, 201):
+            raise CollectorError(
+                f"collection create failed: {resp.status_code} "
+                f"{resp.content[:200]!r}")
+        return job_id
+
+    def poll_once(self, job_id: CollectionJobId, query: Query,
+                  aggregation_parameter: bytes = b"") -> CollectionResult | None:
+        resp = self.session.post(
+            self._url(job_id), headers=self.auth_token.request_headers())
+        if resp.status_code == 202:
+            return None
+        if resp.status_code != 200:
+            raise CollectorError(
+                f"collection poll failed: {resp.status_code} "
+                f"{resp.content[:200]!r}")
+        collection = Collection.decode(resp.content)
+
+        batch_identifier = (
+            query.query_body if query.query_type.NAME == "TimeInterval"
+            else collection.partial_batch_selector.batch_identifier)
+        batch_selector = BatchSelector(query.query_type, batch_identifier)
+        aad = AggregateShareAad(self.task_id, aggregation_parameter,
+                                batch_selector).encode()
+        shares = []
+        for role, ct in ((Role.LEADER, collection.leader_encrypted_agg_share),
+                         (Role.HELPER, collection.helper_encrypted_agg_share)):
+            plaintext = hpke.open_ciphertext(
+                self.hpke_keypair,
+                hpke.application_info(hpke.Label.AGGREGATE_SHARE, role,
+                                      Role.COLLECTOR),
+                ct, aad)
+            shares.append(self.vdaf.decode_agg_share(plaintext))
+        result = self.vdaf.unshard(shares, collection.report_count)
+        return CollectionResult(
+            partial_batch_selector=collection.partial_batch_selector,
+            report_count=collection.report_count,
+            interval=collection.interval,
+            aggregate_result=result,
+        )
+
+    def poll_until_complete(self, job_id: CollectionJobId, query: Query,
+                            aggregation_parameter: bytes = b"",
+                            timeout_s: float = 60.0,
+                            poll_interval_s: float = 0.2) -> CollectionResult:
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            result = self.poll_once(job_id, query, aggregation_parameter)
+            if result is not None:
+                return result
+            if _time.monotonic() > deadline:
+                raise CollectorError("collection timed out")
+            _time.sleep(poll_interval_s)
+
+    def collect(self, query: Query, aggregation_parameter: bytes = b"",
+                timeout_s: float = 60.0) -> CollectionResult:
+        """PUT + poll to completion (reference lib.rs:439)."""
+        job_id = self.start_collection(query, aggregation_parameter)
+        return self.poll_until_complete(job_id, query, aggregation_parameter,
+                                        timeout_s)
+
+    def delete_collection(self, job_id: CollectionJobId) -> None:
+        resp = self.session.delete(self._url(job_id),
+                                   headers=self.auth_token.request_headers())
+        if resp.status_code not in (200, 204):
+            raise CollectorError(f"delete failed: {resp.status_code}")

@@ -1,0 +1,25 @@
+"""Differential-privacy strategy seam (reference core/src/dp.rs:38 and
+collection_job_driver.rs:325).
+
+The reference delegates noise generation to prio's DifferentialPrivacyStrategy;
+`NoDifferentialPrivacy` is the production default.  Custom strategies
+implement `add_noise_to_agg_share(vdaf, agg_share, num_measurements)` and
+return a (possibly noised) share in the same representation.
+"""
+
+from __future__ import annotations
+
+
+class NoDifferentialPrivacy:
+    """Pass-through strategy (reference dp.rs:38)."""
+
+    def add_noise_to_agg_share(self, vdaf, agg_share, num_measurements):
+        return agg_share
+
+
+class DpStrategy:
+    """Base for custom strategies; kept minimal so field-arithmetic noise
+    mechanisms (discrete Gaussian / Laplace over the VDAF field) can plug in."""
+
+    def add_noise_to_agg_share(self, vdaf, agg_share, num_measurements):
+        raise NotImplementedError

@@ -66,9 +66,8 @@ def retry_http_request(request_fn, backoff: Backoff | LimitedRetryer | None = No
     final exception.
     """
     backoff = backoff if backoff is not None else Backoff()
-    last_exc = None
-    last_result = None
-    for interval in backoff.intervals():
+    intervals = iter(backoff.intervals())
+    while True:
         try:
             result = request_fn()
             if not is_retryable_http_status(result.status):
@@ -76,11 +75,11 @@ def retry_http_request(request_fn, backoff: Backoff | LimitedRetryer | None = No
             last_result, last_exc = result, None
         except OSError as e:
             last_exc, last_result = e, None
+        # Attempt first, then sleep only if the budget allows another try
+        # (no pointless delay at budget exhaustion).
+        interval = next(intervals, None)
+        if interval is None:
+            if last_exc is not None:
+                raise last_exc
+            return last_result
         sleep(interval)
-    # budget exhausted: one final attempt result/error
-    if last_result is not None:
-        return last_result
-    if last_exc is not None:
-        raise last_exc
-    # zero-iteration backoff: run once without retry
-    return request_fn()

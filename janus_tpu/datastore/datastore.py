@@ -147,8 +147,12 @@ class Datastore:
         self.crypter = crypter
         self.clock = clock
         self.max_transaction_retries = max_transaction_retries
-        self._write_lock = threading.Lock()
         self.tx_retry_count = 0  # observability (reference tx metrics :237-283)
+        # sqlite shared-cache uses table-level locks, so concurrent in-process
+        # transactions hit SQLITE_LOCKED rather than queueing; serialize them
+        # here (sqlite is single-writer regardless — a Postgres backend gets
+        # real concurrency from the database instead).
+        self._tx_lock = threading.RLock()
 
     def put_schema(self) -> None:
         conn = self.backend.connect()
@@ -175,31 +179,32 @@ class Datastore:
         (reference datastore.rs:232)."""
         last = None
         for _attempt in range(self.max_transaction_retries):
-            conn = self.backend.connect()
-            try:
-                conn.execute("BEGIN IMMEDIATE")
-                tx = Transaction(self, conn, name)
-                result = fn(tx)
-                conn.commit()
-                return result
-            except sqlite3.OperationalError as e:
-                conn.rollback()
-                if "locked" in str(e) or "busy" in str(e):
+            with self._tx_lock:
+                conn = self.backend.connect()
+                try:
+                    conn.execute("BEGIN IMMEDIATE")
+                    tx = Transaction(self, conn, name)
+                    result = fn(tx)
+                    conn.commit()
+                    return result
+                except sqlite3.OperationalError as e:
+                    conn.rollback()
+                    if "locked" in str(e) or "busy" in str(e):
+                        self.tx_retry_count += 1
+                        last = SerializationConflict(str(e))
+                    else:
+                        raise DatastoreError(str(e)) from e
+                except SerializationConflict as e:
+                    conn.rollback()
                     self.tx_retry_count += 1
-                    last = SerializationConflict(str(e))
-                    _time.sleep(0.01)
-                    continue
-                raise DatastoreError(str(e)) from e
-            except SerializationConflict as e:
-                conn.rollback()
-                self.tx_retry_count += 1
-                last = e
-                continue
-            except Exception:
-                conn.rollback()
-                raise
-            finally:
-                conn.close()
+                    last = e
+                except Exception:
+                    conn.rollback()
+                    raise
+                finally:
+                    conn.close()
+            if _attempt + 1 < self.max_transaction_retries:
+                _time.sleep(0.01)
         raise last if last else DatastoreError("transaction retries exhausted")
 
 
@@ -464,6 +469,30 @@ class Transaction:
         )
         if cur.rowcount == 0:
             raise MutationTargetNotFound("no such report")
+
+    def count_client_reports_for_interval(self, task_id: TaskId,
+                                          interval: Interval) -> int:
+        """All reports (aggregated or not) in an interval (reference
+        count_client_reports_for_interval, datastore.rs)."""
+        row = self._exec(
+            """SELECT COUNT(*) FROM client_reports
+               WHERE task_id = ? AND client_timestamp >= ? AND client_timestamp < ?""",
+            (bytes(task_id), interval.start.seconds, interval.end().seconds),
+        ).fetchone()
+        return row[0]
+
+    def count_client_reports_for_batch_id(self, task_id: TaskId,
+                                          batch_id) -> int:
+        """Reports assigned to a fixed-size batch, via their aggregation jobs
+        (reference count_client_reports_for_batch_id, datastore.rs)."""
+        row = self._exec(
+            """SELECT COUNT(DISTINCT ra.report_id) FROM report_aggregations ra
+               JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
+                AND ra.aggregation_job_id = aj.aggregation_job_id
+               WHERE ra.task_id = ? AND aj.batch_id = ? AND ra.state != 'FAILED'""",
+            (bytes(task_id), bytes(batch_id)),
+        ).fetchone()
+        return row[0]
 
     def count_unaggregated_reports_in_interval(self, task_id: TaskId,
                                                interval: Interval) -> int:
@@ -1052,6 +1081,22 @@ class Transaction:
                WHERE task_id = ? AND batch_id = ?""",
             (count, bytes(task_id), bytes(batch_id)),
         )
+
+    def acquire_filled_outstanding_batch(self, task_id: TaskId,
+                                         min_batch_size: int):
+        """Pop one outstanding batch with >= min_batch_size reports for a
+        current-batch collection query (reference datastore.rs
+        acquire_filled_outstanding_batch); returns its BatchId or None."""
+        row = self._exec(
+            """SELECT batch_id FROM outstanding_batches
+               WHERE task_id = ? AND filled >= ? LIMIT 1""",
+            (bytes(task_id), min_batch_size),
+        ).fetchone()
+        if row is None:
+            return None
+        batch_id = BatchId(row[0])
+        self.delete_outstanding_batch(task_id, batch_id)
+        return batch_id
 
     def delete_outstanding_batch(self, task_id: TaskId, batch_id: BatchId) -> None:
         self._exec(

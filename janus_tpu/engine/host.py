@@ -83,8 +83,13 @@ class HostPrepEngine:
         return out
 
     def aggregate(self, reports) -> list:
+        return self.aggregate_raw_rows([
+            rep.out_share_raw for rep in reports
+            if rep.status == "finished" and rep.out_share_raw is not None
+        ])
+
+    def aggregate_raw_rows(self, rows) -> list:
         agg = self.vdaf.aggregate_init()
-        for rep in reports:
-            if rep.status == "finished" and rep.out_share_raw is not None:
-                agg = self.vdaf.aggregate_update(agg, self._raw_to_ints(rep.out_share_raw))
+        for raw in rows:
+            agg = self.vdaf.aggregate_update(agg, self._raw_to_ints(raw))
         return agg

@@ -18,6 +18,7 @@ from __future__ import annotations
 import enum
 import os
 from dataclasses import dataclass, field
+from typing import ClassVar
 
 from janus_tpu.messages.codec import (
     Cursor,
@@ -158,7 +159,7 @@ class Duration(WireMessage):
 
     seconds: int
 
-    ZERO: "Duration" = None  # set below
+    ZERO: ClassVar["Duration"]  # set below
 
     def encode(self) -> bytes:
         return u64(self.seconds)

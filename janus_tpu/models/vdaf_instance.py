@@ -149,6 +149,8 @@ def vdaf_for_instance(inst: VdafInstance):
     if k == "Prio3Histogram":
         return _prio3.new_histogram(inst.length, inst.chunk_length)
     if k == "Fake":
+        if inst.rounds != 1:
+            raise NotImplementedError("DummyVdaf supports exactly 1 round")
         return DummyVdaf()
     if k == "FakeFailsPrepInit":
         return DummyVdaf(fail_prep_init=True)

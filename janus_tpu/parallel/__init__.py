@@ -1,0 +1,77 @@
+"""Multi-chip report-axis parallelism (SURVEY.md §2.7 P1, §5.7/§5.8).
+
+The VDAF prepare workload is embarrassingly parallel over reports: each lane
+of the batched kernels (janus_tpu.engine.batch) depends only on its own
+report's shares and the replicated verify key.  We therefore scale with a
+1-D `jax.sharding.Mesh` over the ``reports`` axis: kernel inputs/outputs are
+sharded on their leading axis, XLA compiles one SPMD program per batch
+bucket, and the only cross-chip communication in the whole pipeline is the
+final aggregate-share reduction (an all-reduce over ICI at batch end —
+the analog of the reference's single merge in aggregate_share.rs:21).
+
+Multi-host: initialize `jax.distributed` before building the mesh and pass
+`jax.devices()` (all global devices); the same shardings then ride DCN
+between hosts.  Nothing else in the engine changes — this mirrors how the
+reference scales by adding stateless replicas (docs/DEPLOYING.md:198),
+except the report axis scales *within* one logical process too.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+REPORT_AXIS = "reports"
+
+
+def report_mesh(devices=None) -> Mesh:
+    """A 1-D device mesh over the report axis.
+
+    `devices` defaults to all local devices; pass `jax.devices()` after
+    `jax.distributed.initialize()` for multi-host meshes.
+    """
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (REPORT_AXIS,))
+
+
+def report_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (report) axis across the mesh."""
+    return NamedSharding(mesh, P(REPORT_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def masked_aggregate(fops, raw, mask):
+    """Masked modular sum of output shares over the report axis.
+
+    raw:  [N, OUT_LEN, LIMBS] uint32 raw field elements
+    mask: [N] bool — True for lanes that contribute (status == finished)
+    ->    [OUT_LEN, LIMBS] raw aggregate share
+
+    Under a report mesh this lowers to per-shard partial sums plus one
+    all-reduce — the only collective in the pipeline.
+    """
+    x = fops.from_raw(raw)  # [N, OUT_LEN, LIMBS] (limb axis is not logical)
+    x = jnp.where(mask[:, None, None], x, jnp.zeros_like(x))
+    return fops.to_raw(fops.sum_mod(x, axis=0))
+
+
+def aggregate_fn(fops, mesh: Mesh | None = None):
+    """A jitted masked-aggregate, sharded over the report axis if a mesh is
+    given (output replicated on every chip)."""
+    fn = lambda raw, mask: masked_aggregate(fops, raw, mask)  # noqa: E731
+    if mesh is None:
+        return jax.jit(fn)
+    shard = report_sharding(mesh)
+    return jax.jit(fn, in_shardings=(shard, shard),
+                   out_shardings=replicated(mesh))

@@ -7,7 +7,7 @@ This is the exact surface Janus consumes from prio (SURVEY.md §2.8):
 Continued/Finished.  The TPU batch engine (janus_tpu.engine) computes the same
 functions over report batches; this module defines semantics and wire format.
 
-Message wire format (tag byte + u32-length-prefixed fields, little-endian
+Message wire format (tag byte + u32-length-prefixed fields, big-endian
 lengths as in TLS-syntax u32 opaque):
 
     initialize(0): prep_share

@@ -114,8 +114,9 @@ def test_retries():
         calls.append(1)
         return HttpResult(503, {}, b"")
 
+    # initial attempt + max_retries retries, no trailing sleep at exhaustion
     result = retry_http_request(always_503, LimitedRetryer(2), sleep=lambda s: None)
-    assert result.status == 503 and len(calls) == 2
+    assert result.status == 503 and len(calls) == 3
 
 
 def test_vdaf_instance_serde():

@@ -1,0 +1,246 @@
+"""In-process helper end-to-end: a Python client shards + HPKE-seals reports,
+a leader-side oracle drives the DAP aggregation sub-protocol against the
+helper over real HTTP, and the stored batch aggregates + aggregate-share
+response are verified against the oracle (SURVEY.md §7 step 4; reference
+aggregator.rs:1712-2156, http_handlers.rs:281-365)."""
+
+import requests
+
+from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.core import hpke
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
+from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+from janus_tpu.messages import (
+    AggregateShare,
+    AggregateShareAad,
+    AggregateShareReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    BatchSelector,
+    Duration,
+    HpkeConfigList,
+    InputShareAad,
+    Interval,
+    PartialBatchSelector,
+    PlaintextInputShare,
+    PrepareInit,
+    PrepareStepResult,
+    ReportIdChecksum,
+    ReportShare,
+    Role,
+    Time,
+)
+from janus_tpu.models import VdafInstance
+from janus_tpu.vdaf import ping_pong
+
+
+def _helper_fixture(vdaf_instance=None, min_batch_size=1):
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          vdaf_instance or VdafInstance.prio3_count())
+    builder.with_min_batch_size(min_batch_size)
+    task = builder.helper_view()
+    clock = MockClock(Time(1_600_000_000))
+    ds = Datastore(SqliteBackend(), Crypter.generate(), clock)
+    ds.put_schema()
+    ds.run_tx("put_task", lambda tx: tx.put_aggregator_task(task))
+    agg = Aggregator(ds, clock, AggregatorConfig(batch_aggregation_shard_count=4))
+    server = DapHttpServer(agg).start()
+    return builder, task, clock, ds, agg, server
+
+
+class _LeaderOracle:
+    """Test-only leader: prepares reports and ping-pong init messages."""
+
+    def __init__(self, builder, clock):
+        self.builder = builder
+        self.clock = clock
+        self.task = builder.leader_view()
+        from janus_tpu.models.vdaf_instance import vdaf_for_instance
+
+        self.vdaf = vdaf_for_instance(builder.vdaf)
+        self.client = Client(
+            ClientParameters(builder.task_id, "http://leader.invalid",
+                             "http://helper.invalid", builder.time_precision),
+            builder.vdaf,
+            leader_hpke_config=builder.leader_hpke_keypair.config,
+            helper_hpke_config=builder.helper_hpke_keypair.config,
+            clock=clock,
+        )
+
+    def make_prepare_init(self, measurement):
+        report = self.client.prepare_report(measurement, time=self.clock.now())
+        aad = InputShareAad(self.builder.task_id, report.metadata,
+                            report.public_share).encode()
+        plaintext = hpke.open_ciphertext(
+            self.builder.leader_hpke_keypair,
+            hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+            report.leader_encrypted_input_share, aad)
+        payload = PlaintextInputShare.decode(plaintext).payload
+        pub = self.vdaf.decode_public_share(report.public_share)
+        share = self.vdaf.decode_input_share(0, payload)
+        state, msg = ping_pong.leader_initialized(
+            self.vdaf, self.builder.verify_key, bytes(report.metadata.report_id),
+            pub, share)
+        rs = ReportShare(report.metadata, report.public_share,
+                         report.helper_encrypted_input_share)
+        return PrepareInit(rs, msg.encode()), state
+
+
+def test_helper_aggregate_init_and_share_over_http():
+    builder, task, clock, ds, agg, server = _helper_fixture()
+    try:
+        sess = requests.Session()
+        base = f"{server.address}/tasks/{task.task_id}"
+
+        # hpke_config endpoint serves the helper's config
+        r = sess.get(f"{server.address}/hpke_config?task_id={task.task_id}")
+        assert r.status_code == 200
+        configs = HpkeConfigList.decode(r.content).configs
+        assert configs[0] == builder.helper_hpke_keypair.config
+
+        leader = _LeaderOracle(builder, clock)
+        measurements = [1, 0, 1, 1, 1]
+        inits, states = [], []
+        for meas in measurements:
+            pi, state = leader.make_prepare_init(meas)
+            inits.append(pi)
+            states.append(state)
+
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector(
+                task.query_type.query_type),
+            prepare_inits=tuple(inits),
+        )
+        job_id = AggregationJobId.random()
+        auth = builder.aggregator_auth_token.request_headers()
+        url = f"{base}/aggregation_jobs/{job_id}"
+        r = sess.put(url, data=req.encode(), headers=auth)
+        assert r.status_code == 200, r.content
+        resp = AggregationJobResp.decode(r.content)
+        assert len(resp.prepare_resps) == len(measurements)
+
+        # leader finishes with the helper's outbound messages; sum out shares
+        leader_agg = leader.vdaf.aggregate_init()
+        for pr, state in zip(resp.prepare_resps, states):
+            assert pr.result.kind == PrepareStepResult.CONTINUE
+            msg = ping_pong.PingPongMessage.decode(pr.result.message)
+            finished = ping_pong.leader_continued(leader.vdaf, state, msg)
+            leader_agg = leader.vdaf.aggregate_update(leader_agg,
+                                                      finished.out_share)
+
+        # unauthenticated requests are rejected
+        r = sess.put(url, data=req.encode())
+        assert r.status_code == 403
+
+        # exact replay is re-served idempotently
+        r = sess.put(url, data=req.encode(), headers=auth)
+        assert r.status_code == 200
+        assert AggregationJobResp.decode(r.content) == resp
+
+        # same job id, mutated content -> conflict
+        req2 = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector(
+                task.query_type.query_type),
+            prepare_inits=tuple(inits[:2]),
+        )
+        r = sess.put(url, data=req2.encode(), headers=auth)
+        assert r.status_code == 409
+
+        # reports replayed into a different job fail per-lane
+        job2 = AggregationJobId.random()
+        r = sess.put(f"{base}/aggregation_jobs/{job2}", data=req2.encode(),
+                     headers=auth)
+        assert r.status_code == 200
+        for pr in AggregationJobResp.decode(r.content).prepare_resps:
+            assert pr.result.kind == PrepareStepResult.REJECT
+
+        # aggregate share: helper's share + leader's share unshard to the sum
+        checksum = ReportIdChecksum.zero()
+        for pi in inits:
+            checksum = checksum.updated_with(pi.report_share.metadata.report_id)
+        batch_interval = Interval(
+            clock.now().round_down(task.time_precision), task.time_precision)
+        asr = AggregateShareReq(
+            batch_selector=BatchSelector(task.query_type.query_type,
+                                         batch_interval),
+            aggregation_parameter=b"",
+            report_count=len(measurements),
+            checksum=checksum,
+        )
+        r = sess.post(f"{base}/aggregate_shares", data=asr.encode(), headers=auth)
+        assert r.status_code == 200, r.content
+        share_msg = AggregateShare.decode(r.content)
+        aad = AggregateShareAad(task.task_id, b"", asr.batch_selector).encode()
+        helper_share_bytes = hpke.open_ciphertext(
+            builder.collector_keypair,
+            hpke.application_info(hpke.Label.AGGREGATE_SHARE, Role.HELPER,
+                                  Role.COLLECTOR),
+            share_msg.encrypted_aggregate_share, aad)
+        helper_agg = leader.vdaf.decode_agg_share(helper_share_bytes)
+        total = leader.vdaf.unshard([leader_agg, helper_agg], len(measurements))
+        assert total == sum(measurements)
+
+        # wrong checksum in a fresh window -> batch mismatch
+        asr_bad = AggregateShareReq(
+            batch_selector=asr.batch_selector, aggregation_parameter=b"",
+            report_count=len(measurements) + 1, checksum=checksum)
+        r = sess.post(f"{base}/aggregate_shares", data=asr_bad.encode(),
+                      headers=auth)
+        assert r.status_code == 400
+    finally:
+        server.stop()
+
+
+def test_helper_init_sumvec_device_path():
+    """The helper hot loop runs the device kernels for a jr-using VDAF."""
+    builder, task, clock, ds, agg, server = _helper_fixture(
+        VdafInstance.prio3_sum_vec(bits=1, length=8, chunk_length=3))
+    try:
+        sess = requests.Session()
+        leader = _LeaderOracle(builder, clock)
+        meas = [[1, 0, 1, 0, 0, 1, 1, 0], [0] * 8, [1] * 8]
+        inits, states = [], []
+        for mv in meas:
+            pi, st = leader.make_prepare_init(mv)
+            inits.append(pi)
+            states.append(st)
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector(task.query_type.query_type),
+            prepare_inits=tuple(inits),
+        )
+        job_id = AggregationJobId.random()
+        r = sess.put(
+            f"{server.address}/tasks/{task.task_id}/aggregation_jobs/{job_id}",
+            data=req.encode(),
+            headers=builder.aggregator_auth_token.request_headers())
+        assert r.status_code == 200, r.content
+        resp = AggregationJobResp.decode(r.content)
+        leader_agg = leader.vdaf.aggregate_init()
+        for pr, st in zip(resp.prepare_resps, states):
+            assert pr.result.kind == PrepareStepResult.CONTINUE, pr
+            finished = ping_pong.leader_continued(
+                leader.vdaf, st, ping_pong.PingPongMessage.decode(pr.result.message))
+            leader_agg = leader.vdaf.aggregate_update(leader_agg, finished.out_share)
+
+        shards = ds.run_tx("read", lambda tx: tx.get_batch_aggregations(
+            task.task_id,
+            Interval(clock.now().round_down(task.time_precision),
+                     task.time_precision), b""))
+        total_count = sum(ba.report_count for ba in shards)
+        assert total_count == len(meas)
+        helper_agg = None
+        for ba in shards:
+            if ba.aggregate_share is not None:
+                part = leader.vdaf.decode_agg_share(ba.aggregate_share)
+                helper_agg = part if helper_agg is None else \
+                    leader.vdaf.aggregate_update(helper_agg, part)
+        total = leader.vdaf.unshard([leader_agg, helper_agg], len(meas))
+        assert total == [sum(col) for col in zip(*meas)]
+    finally:
+        server.stop()

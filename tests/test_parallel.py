@@ -1,0 +1,103 @@
+"""Multi-device report-axis sharding: sharded engine must be bit-identical
+to the single-device engine, and the device aggregate must match the oracle
+fold (SURVEY.md §2.7 P1, §5.7)."""
+
+import jax
+import numpy as np
+import pytest
+
+from janus_tpu.engine.batch import BatchPrio3
+from janus_tpu.parallel import aggregate_fn, masked_aggregate, report_mesh
+from janus_tpu.vdaf import ping_pong, prio3
+from janus_tpu.vdaf.transcript import run_vdaf
+
+
+def _mesh(n=8):
+    devices = jax.devices()
+    if len(devices) < n:
+        pytest.skip(f"need {n} devices, have {len(devices)}")
+    return report_mesh(devices[:n])
+
+
+def _reports(vdaf, verify_key, measurements):
+    nonces, pubs, shares, inits = [], [], [], []
+    for i, meas in enumerate(measurements):
+        nonce = i.to_bytes(16, "big")
+        pub, ishares = vdaf.shard(meas, nonce, bytes(range(i, i + vdaf.RAND_SIZE)))
+        _st, msg = ping_pong.leader_initialized(vdaf, verify_key, nonce, pub, ishares[0])
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares.append(vdaf.encode_input_share(1, ishares[1]))
+        inits.append(msg)
+    return nonces, pubs, shares, inits
+
+
+@pytest.mark.parametrize("make,meas", [
+    (prio3.new_count, [1, 0, 1, 1, 0, 1, 0, 1, 1, 1]),          # no joint rand
+    (lambda: prio3.new_sum_vec(8, 2, 3), [[i % 4] * 8 for i in range(10)]),
+])
+def test_sharded_helper_matches_single_device(make, meas):
+    mesh = _mesh()
+    vdaf = make()
+    verify_key = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    nonces, pubs, shares, inits = _reports(vdaf, verify_key, meas)
+
+    sharded = BatchPrio3(vdaf, mesh=mesh)
+    single = BatchPrio3(vdaf)
+    res_s = sharded.helper_init_batch(verify_key, nonces, pubs, shares, inits)
+    res_1 = single.helper_init_batch(verify_key, nonces, pubs, shares, inits)
+    for a, b in zip(res_s, res_1):
+        assert a.status == b.status == "finished", (a.error, b.error)
+        assert a.prep_share == b.prep_share
+        assert a.outbound.encode() == b.outbound.encode()
+        assert np.array_equal(a.out_share_raw, b.out_share_raw)
+    assert sharded.aggregate(res_s) == single.aggregate(res_1)
+
+
+def test_sharded_aggregate_matches_oracle():
+    mesh = _mesh()
+    vdaf = prio3.new_histogram(4, 2)
+    verify_key = b"\x07" * vdaf.VERIFY_KEY_SIZE
+    engine = BatchPrio3(vdaf, mesh=mesh)
+    # oracle aggregate over transcripts
+    agg = vdaf.aggregate_init()
+    rows, mask_rows = [], []
+    for i, meas in enumerate([0, 1, 2, 3, 1, 1]):
+        t = run_vdaf(vdaf, verify_key, meas, nonce=i.to_bytes(16, "big"))
+        out = t.out_shares[1]
+        agg = vdaf.aggregate_update(agg, out)
+        rows.append(engine._ints_to_raw(out))
+        mask_rows.append(True)
+    # pad to a devices multiple with masked-off garbage lanes
+    while len(rows) % mesh.size:
+        rows.append(np.full_like(rows[0], 7))
+        mask_rows.append(False)
+    arr = np.stack(rows)
+    mask = np.asarray(mask_rows)
+    fn = aggregate_fn(engine.f, mesh)
+    got = engine._raw_to_ints(np.asarray(fn(arr, mask)))
+    assert got == agg
+    # unsharded path agrees too
+    got1 = engine._raw_to_ints(np.asarray(masked_aggregate(engine.f, arr, mask)))
+    assert got1 == agg
+
+
+def test_sharded_leader_matches_single_device():
+    mesh = _mesh()
+    vdaf = prio3.new_sum(8)
+    verify_key = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    nonces, pubs, lshares = [], [], []
+    for i, meas in enumerate([3, 200, 17, 0, 255, 9, 1, 2]):
+        nonce = i.to_bytes(16, "big")
+        pub, ishares = vdaf.shard(meas, nonce, bytes(range(i, i + vdaf.RAND_SIZE)))
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        lshares.append(vdaf.encode_input_share(0, ishares[0]))
+    sharded = BatchPrio3(vdaf, mesh=mesh)
+    single = BatchPrio3(vdaf)
+    res_s = sharded.leader_init_batch(verify_key, nonces, pubs, lshares)
+    res_1 = single.leader_init_batch(verify_key, nonces, pubs, lshares)
+    for a, b in zip(res_s, res_1):
+        assert a.status == b.status == "continued"
+        assert a.prep_share == b.prep_share
+        assert np.array_equal(a.out_share_raw, b.out_share_raw)
