@@ -135,9 +135,20 @@ class Aggregator:
 
     # -- authentication ---------------------------------------------------
 
-    @staticmethod
-    def _check_aggregator_auth(task: AggregatorTask,
+    def _check_aggregator_auth(self, task: AggregatorTask,
                                token: AuthenticationToken | None) -> None:
+        # Taskprov tasks authenticate against the peer aggregator's full
+        # token list on every request (supports rotation; reference
+        # taskprov_authorize_request, aggregator.rs:798).
+        if task.taskprov:
+            peer = self.datastore.run_tx(
+                "get_taskprov_peer",
+                lambda tx: tx.get_taskprov_peer_aggregator(
+                    task.peer_aggregator_endpoint, Role.LEADER))
+            if peer is not None and peer.check_aggregator_auth_token(token):
+                return
+            raise err.UnauthorizedRequest("taskprov authentication failed",
+                                          task.task_id)
         if not task.check_aggregator_auth(token):
             raise err.UnauthorizedRequest("aggregator authentication failed",
                                           task.task_id)
@@ -163,6 +174,14 @@ class Aggregator:
                                         " keys are configured")
             return HpkeConfigList(tuple(active)).encode()
         ta = self.task_aggregator(task_id)
+        if not ta.task.hpke_keys:
+            # Taskprov tasks have no per-task keys: serve the global ones
+            # (the same keys handle_aggregate_init decrypts with).
+            keypairs = self.datastore.run_tx(
+                "get_global_hpke", lambda tx: tx.get_global_hpke_keypairs())
+            active = [gk.keypair.config for gk in keypairs
+                      if gk.state is m.HpkeKeyState.ACTIVE]
+            return HpkeConfigList(tuple(active)).encode()
         return ta.hpke_config_list().encode()
 
     # -- upload (reference aggregator.rs:1513) ----------------------------
@@ -244,12 +263,109 @@ class Aggregator:
                 return gk.keypair
         return None
 
+    # -- taskprov opt-in (reference aggregator.rs:709) --------------------
+
+    def taskprov_opt_in(self, task_id: TaskId, taskprov_header: str,
+                        auth: AuthenticationToken | None) -> None:
+        """Provision a helper task in-band from a dap-taskprov header."""
+        import base64
+
+        from janus_tpu.messages.taskprov import TaskConfig, TaskprovQuery
+        from janus_tpu.datastore.task import QueryTypeCfg
+
+        try:
+            pad = "=" * (-len(taskprov_header) % 4)
+            config_bytes = base64.urlsafe_b64decode(taskprov_header + pad)
+        except Exception as e:
+            raise err.InvalidMessage("taskprov header could not be decoded",
+                                     task_id) from e
+        if hashlib.sha256(config_bytes).digest() != bytes(task_id):
+            raise err.InvalidMessage(
+                "derived taskprov task ID does not match task config", task_id)
+        try:
+            tc = TaskConfig.decode(config_bytes)
+        except Exception as e:
+            raise err.InvalidMessage(f"malformed task config: {e}",
+                                     task_id) from e
+
+        # We act as the helper; our peer is the leader.
+        peer_endpoint = str(tc.leader_aggregator_endpoint)
+        peer = self.datastore.run_tx(
+            "get_taskprov_peer",
+            lambda tx: tx.get_taskprov_peer_aggregator(peer_endpoint,
+                                                       Role.LEADER))
+        if peer is None:
+            raise err.InvalidTask(f"no such taskprov peer {peer_endpoint}",
+                                  task_id)
+        if not peer.check_aggregator_auth_token(auth):
+            raise err.UnauthorizedRequest("taskprov authentication failed",
+                                          task_id)
+        if self.clock.now().is_after(tc.task_expiration):
+            raise err.InvalidTask("task has expired", task_id)
+        if not tc.vdaf_config.dp_config.dp_mechanism.is_recognized:
+            raise err.InvalidTask("unrecognized DP mechanism", task_id)
+        try:
+            vdaf_instance = tc.vdaf_config.vdaf_type.to_vdaf_instance()
+        except ValueError as e:
+            raise err.InvalidTask(str(e), task_id) from e
+
+        q = tc.query_config.query
+        if q.kind == TaskprovQuery.TIME_INTERVAL:
+            query_cfg = QueryTypeCfg.time_interval()
+        elif q.kind == TaskprovQuery.FIXED_SIZE:
+            query_cfg = QueryTypeCfg.fixed_size(q.max_batch_size)
+        else:
+            raise err.InvalidTask("reserved query type", task_id)
+
+        from janus_tpu.core.auth_tokens import AuthenticationTokenHash
+
+        task = AggregatorTask(
+            task_id=task_id,
+            peer_aggregator_endpoint=peer_endpoint,
+            query_type=query_cfg,
+            vdaf=vdaf_instance,
+            role=Role.HELPER,
+            vdaf_verify_key=peer.derive_vdaf_verify_key(task_id, vdaf_instance),
+            min_batch_size=tc.query_config.min_batch_size,
+            time_precision=tc.query_config.time_precision,
+            tolerable_clock_skew=peer.tolerable_clock_skew,
+            task_expiration=tc.task_expiration,
+            report_expiry_age=peer.report_expiry_age,
+            collector_hpke_config=peer.collector_hpke_config,
+            aggregator_auth_token_hash=AuthenticationTokenHash.of(auth),
+            hpke_keys=(),  # taskprov tasks use the global HPKE keys
+            taskprov=True,
+        )
+
+        def txn(tx):
+            try:
+                tx.put_aggregator_task(task)
+            except MutationTargetAlreadyExists:
+                pass  # another replica/request opted in first
+
+        self.datastore.run_tx("taskprov_put_task", txn)
+        self.invalidate_task_cache(task_id)
+
+    def _task_aggregator_taskprov(self, task_id: TaskId,
+                                  taskprov_header: str | None,
+                                  auth: AuthenticationToken | None
+                                  ) -> TaskAggregator:
+        """Task lookup with in-band opt-in fallback."""
+        try:
+            return self.task_aggregator(task_id)
+        except err.UnrecognizedTask:
+            if not (self.cfg.taskprov_enabled and taskprov_header):
+                raise
+        self.taskprov_opt_in(task_id, taskprov_header, auth)
+        return self.task_aggregator(task_id)
+
     # -- helper aggregate-init (reference aggregator.rs:1712) -------------
 
     def handle_aggregate_init(self, task_id: TaskId, job_id: AggregationJobId,
                               body: bytes,
-                              auth: AuthenticationToken | None) -> bytes:
-        ta = self.task_aggregator(task_id)
+                              auth: AuthenticationToken | None,
+                              taskprov_header: str | None = None) -> bytes:
+        ta = self._task_aggregator_taskprov(task_id, taskprov_header, auth)
         task = ta.task
         if task.role is not Role.HELPER:
             raise err.UnrecognizedTask(task_id)
@@ -306,6 +422,21 @@ class Aggregator:
                 ext_types = [e.extension_type for e in pis.extensions]
                 if len(ext_types) != len(set(ext_types)):
                     raise ValueError("duplicate extensions")
+                # Taskprov tasks require the (empty) taskprov extension;
+                # non-taskprov tasks must not see it (reference
+                # aggregator.rs:1870-1904).
+                from janus_tpu.messages import ExtensionType
+
+                has_tp = any(
+                    e.extension_type == ExtensionType.TASKPROV
+                    and e.extension_data == b""
+                    for e in pis.extensions)
+                if task.taskprov and not has_tp:
+                    raise ValueError("missing taskprov extension")
+                if not task.taskprov and any(
+                        e.extension_type == ExtensionType.TASKPROV
+                        for e in pis.extensions):
+                    raise ValueError("unexpected taskprov extension")
             except Exception:
                 lane_error[i] = PrepareError.INVALID_MESSAGE
                 continue
@@ -532,11 +663,9 @@ class Aggregator:
             raise err.InvalidMessage("query type mismatch", task_id)
 
         def txn(tx):
-            ident = ta.logic.collection_identifier_for_query(tx, task, req.query)
-            if ident is None:
-                raise err.BatchInvalid("no batch available for query", task_id)
-            if not ta.logic.validate_collection_identifier(task, ident):
-                raise err.BatchInvalid("misaligned collection interval", task_id)
+            # Existing-job check FIRST: a retried current-batch query must not
+            # consume another outstanding batch (acquire_filled_outstanding_batch
+            # pops one as a side effect).
             existing = tx.get_collection_job(task_id, job_id)
             if existing is not None:
                 if (existing.query.encode() != req.query.encode()
@@ -545,6 +674,11 @@ class Aggregator:
                     raise err.ForbiddenMutation(
                         f"collection job {job_id}", task_id)
                 return  # idempotent create
+            ident = ta.logic.collection_identifier_for_query(tx, task, req.query)
+            if ident is None:
+                raise err.BatchInvalid("no batch available for query", task_id)
+            if not ta.logic.validate_collection_identifier(task, ident):
+                raise err.BatchInvalid("misaligned collection interval", task_id)
             if not ta.logic.validate_query_count(
                     tx, task, ident, self.cfg.max_batch_query_count):
                 raise err.BatchQueriedTooManyTimes("query count exceeded", task_id)

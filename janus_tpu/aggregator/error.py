@@ -178,5 +178,11 @@ class EmptyAggregation(AggregatorError):
         super().__init__("aggregation job contains no report shares", task_id)
 
 
+class InvalidTask(AggregatorError):
+    """Taskprov opt-out (reference error.rs OptOutReason)."""
+
+    problem = DapProblemType.INVALID_TASK
+
+
 class InternalError(AggregatorError):
     status = 500

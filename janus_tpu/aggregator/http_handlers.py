@@ -83,22 +83,36 @@ class DapRouter:
 
     def handle(self, method: str, path: str, query: dict, body: bytes,
                headers) -> _Response:
+        import time as _t
+
+        from janus_tpu.metrics import http_request_duration
+
+        t0 = _t.monotonic()
+        route = "unmatched"  # bounded label even on error paths
         try:
             for m_, rx, name in _ROUTES:
                 if m_ != method:
                     continue
                 match = rx.match(path)
                 if match:
-                    return getattr(self, "_" + name)(match, query, body, headers)
+                    route = name
+                    resp = getattr(self, "_" + name)(match, query, body, headers)
+                    http_request_duration.observe(
+                        _t.monotonic() - t0, route=route, status=resp.status)
+                    return resp
             return _Response(404, json.dumps({
                 "status": 404, "detail": "no such route"}).encode(), PROBLEM_JSON)
         except err.AggregatorError as e:
             status, doc = e.problem_document()
+            http_request_duration.observe(_t.monotonic() - t0, route=route,
+                                          status=status)
             if status == 204:
                 return _Response(204)
             return _Response(status, json.dumps(doc).encode(), PROBLEM_JSON)
         except Exception:
             traceback.print_exc()
+            http_request_duration.observe(_t.monotonic() - t0, route=route,
+                                          status=500)
             return _Response(500, json.dumps({
                 "status": 500, "detail": "internal error"}).encode(), PROBLEM_JSON)
 
@@ -119,10 +133,13 @@ class DapRouter:
         return _Response(201)
 
     def _agg_init(self, match, query, body, headers) -> _Response:
+        from janus_tpu.messages.taskprov import TASKPROV_HEADER
+
         task_id = TaskId.from_str(match.group(1))
         job_id = AggregationJobId.from_str(match.group(2))
         data = self.aggregator.handle_aggregate_init(
-            task_id, job_id, body, _parse_auth(headers))
+            task_id, job_id, body, _parse_auth(headers),
+            taskprov_header=headers.get(TASKPROV_HEADER))
         return _Response(200, data, AggregationJobResp.MEDIA_TYPE)
 
     def _agg_cont(self, match, query, body, headers) -> _Response:

@@ -37,7 +37,13 @@ class JobDriver:
     def run_once(self) -> int:
         """One discovery round: acquire up to the concurrency limit and step
         every lease (synchronously, on the pool).  Returns #jobs stepped."""
+        import time as _t
+
+        from janus_tpu.metrics import job_acquire_time
+
+        t0 = _t.monotonic()
         leases = self.acquirer(self.cfg.max_concurrent_job_workers)
+        job_acquire_time.observe(_t.monotonic() - t0)
         if not leases:
             return 0
         with ThreadPoolExecutor(self.cfg.max_concurrent_job_workers) as pool:
@@ -47,11 +53,20 @@ class JobDriver:
         return len(leases)
 
     def _step(self, lease) -> None:
+        import time as _t
+
+        from janus_tpu.metrics import job_step_time
+
+        t0 = _t.monotonic()
+        status = "success"
         try:
             self.stepper(lease)
         except Exception:
             # The lease simply expires; another replica will retry.
+            status = "error"
             traceback.print_exc()
+        finally:
+            job_step_time.observe(_t.monotonic() - t0, status=status)
 
     def run(self) -> None:
         """Discovery loop until stop() (reference job_driver.rs:100)."""

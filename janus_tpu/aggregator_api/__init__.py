@@ -1,0 +1,429 @@
+"""Operator control-plane REST API (reference aggregator_api/src/lib.rs:71,
+routes.rs:32-455): task CRUD, upload metrics, global HPKE key rotation,
+taskprov peer CRUD.  JSON over HTTP with bearer-token auth and a versioned
+media type."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from janus_tpu.core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import (
+    Datastore,
+    MutationTargetAlreadyExists,
+    MutationTargetNotFound,
+)
+from janus_tpu.datastore.task import AggregatorTask, QueryTypeCfg
+from janus_tpu.messages import Duration, HpkeConfig, Role, TaskId, Time
+from janus_tpu.models import VdafInstance
+
+CONTENT_TYPE = "application/vnd.janus.aggregator+json;version=0.1"
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, detail: str):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail
+
+
+def _task_resp(task: AggregatorTask) -> dict:
+    out = {
+        "task_id": str(task.task_id),
+        "peer_aggregator_endpoint": task.peer_aggregator_endpoint,
+        "query_type": task.query_type.to_json_obj(),
+        "vdaf": task.vdaf.to_json_obj(),
+        "role": task.role.name.title(),
+        "vdaf_verify_key": _b64(task.vdaf_verify_key),
+        "task_expiration": (task.task_expiration.seconds
+                            if task.task_expiration else None),
+        "report_expiry_age": (task.report_expiry_age.seconds
+                              if task.report_expiry_age else None),
+        "min_batch_size": task.min_batch_size,
+        "time_precision": task.time_precision.seconds,
+        "tolerable_clock_skew": task.tolerable_clock_skew.seconds,
+        "collector_hpke_config": (_b64(task.collector_hpke_config.encode())
+                                  if task.collector_hpke_config else None),
+        "taskprov": task.taskprov,
+    }
+    if task.aggregator_auth_token is not None:
+        out["aggregator_auth_token"] = {
+            "type": task.aggregator_auth_token.token_type,
+            "token": task.aggregator_auth_token.token,
+        }
+    return out
+
+
+class AggregatorApi:
+    """Transport-independent handler set; see AggregatorApiServer for HTTP."""
+
+    def __init__(self, datastore: Datastore, auth_tokens: list[AuthenticationToken],
+                 public_dap_url: str = ""):
+        self.datastore = datastore
+        self.auth_hashes = [AuthenticationTokenHash.of(t) for t in auth_tokens]
+        self.public_dap_url = public_dap_url
+
+    # -- auth ---------------------------------------------------------------
+
+    def check_auth(self, headers) -> None:
+        authz = headers.get("Authorization") or ""
+        if not authz.startswith("Bearer "):
+            raise ApiError(401, "missing bearer token")
+        token = AuthenticationToken.bearer(authz[len("Bearer "):])
+        if not any(h.matches(token) for h in self.auth_hashes):
+            raise ApiError(401, "unauthorized")
+
+    # -- routes ---------------------------------------------------------------
+
+    def get_config(self) -> dict:
+        return {
+            "protocol": "DAP-09",
+            "dap_url": self.public_dap_url,
+            "role": "Either",
+            "vdafs": ["Prio3Count", "Prio3Sum", "Prio3Histogram", "Prio3SumVec",
+                      "Prio3SumVecField64MultiproofHmacSha256Aes128"],
+            "query_types": ["TimeInterval", "FixedSize"],
+            "features": ["TokenHash", "UploadMetrics"],
+        }
+
+    def get_task_ids(self, pagination_token: str | None) -> dict:
+        lower = TaskId.from_str(pagination_token) if pagination_token else None
+
+        def txn(tx):
+            tasks = tx.get_aggregator_tasks()
+            ids = sorted(str(t.task_id) for t in tasks)
+            if lower is not None:
+                ids = [i for i in ids if i > str(lower)]
+            return ids
+
+        ids = self.datastore.run_tx("get_task_ids", txn)
+        return {"task_ids": ids, "pagination_token": ids[-1] if ids else None}
+
+    def post_task(self, body: dict) -> dict:
+        try:
+            role = Role[body["role"].upper()]
+            if role not in (Role.LEADER, Role.HELPER):
+                raise ApiError(400, f"invalid role {body['role']}")
+            vdaf = VdafInstance.from_json_obj(body["vdaf"])
+            verify_key = _unb64(body["vdaf_verify_key"])
+            if len(verify_key) != vdaf.verify_key_length:
+                raise ApiError(400, "wrong VDAF verify key length")
+            query_type = QueryTypeCfg.from_json_obj(body["query_type"])
+        except (KeyError, ValueError) as e:
+            raise ApiError(400, f"bad task request: {e}") from e
+
+        # Task ID derives from the verify key: SHA-256(verify_key)
+        # (reference routes.rs:105-108).
+        task_id = TaskId(hashlib.sha256(verify_key).digest())
+
+        agg_token = None
+        agg_hash = None
+        if role is Role.LEADER:
+            tok = body.get("aggregator_auth_token")
+            if tok is None:
+                raise ApiError(400, "leader task requires aggregator_auth_token")
+            agg_token = AuthenticationToken(tok["type"], tok["token"])
+        else:
+            tok = body.get("aggregator_auth_token")
+            if tok is None:
+                raise ApiError(400, "helper task requires aggregator_auth_token")
+            agg_hash = AuthenticationTokenHash.of(
+                AuthenticationToken(tok["type"], tok["token"]))
+        col_hash = None
+        if body.get("collector_auth_token_hash"):
+            col_hash = AuthenticationTokenHash(
+                "Bearer", _unb64(body["collector_auth_token_hash"]))
+
+        keypair = HpkeKeypair.generate(1)
+        task = AggregatorTask(
+            task_id=task_id,
+            peer_aggregator_endpoint=body["peer_aggregator_endpoint"],
+            query_type=query_type,
+            vdaf=vdaf,
+            role=role,
+            vdaf_verify_key=verify_key,
+            min_batch_size=body["min_batch_size"],
+            time_precision=Duration(body["time_precision"]),
+            tolerable_clock_skew=Duration(body.get("tolerable_clock_skew", 60)),
+            task_expiration=(Time(body["task_expiration"])
+                             if body.get("task_expiration") is not None else None),
+            report_expiry_age=(Duration(body["report_expiry_age"])
+                               if body.get("report_expiry_age") is not None else None),
+            collector_hpke_config=(HpkeConfig.decode(_unb64(body["collector_hpke_config"]))
+                                   if body.get("collector_hpke_config") else None),
+            aggregator_auth_token=agg_token,
+            aggregator_auth_token_hash=agg_hash,
+            collector_auth_token_hash=col_hash,
+            hpke_keys=(keypair,),
+        )
+        try:
+            self.datastore.run_tx(
+                "post_task", lambda tx: tx.put_aggregator_task(task))
+        except MutationTargetAlreadyExists as e:
+            raise ApiError(409, "task already exists") from e
+        return _task_resp(task)
+
+    def get_task(self, task_id: TaskId) -> dict:
+        task = self.datastore.run_tx(
+            "get_task", lambda tx: tx.get_aggregator_task(task_id))
+        if task is None:
+            raise ApiError(404, "no such task")
+        return _task_resp(task)
+
+    def delete_task(self, task_id: TaskId) -> None:
+        try:
+            self.datastore.run_tx(
+                "delete_task", lambda tx: tx.delete_task(task_id))
+        except MutationTargetNotFound:
+            pass  # deletion is idempotent (reference routes.rs:241)
+
+    def get_upload_metrics(self, task_id: TaskId) -> dict:
+        counter = self.datastore.run_tx(
+            "metrics", lambda tx: tx.get_task_upload_counter(task_id))
+        return {f: getattr(counter, f) for f in counter.__dataclass_fields__}
+
+    # -- global HPKE configs -------------------------------------------------
+
+    def get_hpke_configs(self) -> list[dict]:
+        keypairs = self.datastore.run_tx(
+            "hpke", lambda tx: tx.get_global_hpke_keypairs())
+        return [{
+            "config": _b64(gk.keypair.config.encode()),
+            "config_id": gk.keypair.config.id.value,
+            "state": gk.state.value,
+        } for gk in keypairs]
+
+    def put_hpke_config(self, body: dict) -> dict:
+        config_id = body.get("config_id")
+        if config_id is None:
+            existing = {g["config_id"] for g in self.get_hpke_configs()}
+            config_id = next(i for i in range(256) if i not in existing)
+        keypair = HpkeKeypair.generate(config_id)
+        self.datastore.run_tx(
+            "hpke_put", lambda tx: tx.put_global_hpke_keypair(keypair))
+        return {"config_id": config_id, "state": m.HpkeKeyState.PENDING.value}
+
+    def patch_hpke_config(self, config_id: int, body: dict) -> None:
+        state = m.HpkeKeyState(body["state"])
+        self.datastore.run_tx(
+            "hpke_patch",
+            lambda tx: tx.set_global_hpke_keypair_state(config_id, state))
+
+    def delete_hpke_config(self, config_id: int) -> None:
+        self.datastore.run_tx(
+            "hpke_del", lambda tx: tx.delete_global_hpke_keypair(config_id))
+
+    # -- taskprov peers --------------------------------------------------------
+
+    def get_taskprov_peers(self) -> list[dict]:
+        peers = self.datastore.run_tx(
+            "peers", lambda tx: tx.get_taskprov_peer_aggregators())
+        return [{
+            "endpoint": p.endpoint,
+            "role": p.role.name.title(),
+            "collector_hpke_config": _b64(p.collector_hpke_config.encode()),
+            "report_expiry_age": (p.report_expiry_age.seconds
+                                  if p.report_expiry_age else None),
+            "tolerable_clock_skew": p.tolerable_clock_skew.seconds,
+        } for p in peers]
+
+    def post_taskprov_peer(self, body: dict) -> dict:
+        from janus_tpu.taskprov import PeerAggregator
+
+        peer = PeerAggregator(
+            endpoint=body["endpoint"],
+            role=Role[body["role"].upper()],
+            verify_key_init=_unb64(body["verify_key_init"]),
+            collector_hpke_config=HpkeConfig.decode(
+                _unb64(body["collector_hpke_config"])),
+            report_expiry_age=(Duration(body["report_expiry_age"])
+                               if body.get("report_expiry_age") is not None
+                               else None),
+            tolerable_clock_skew=Duration(body.get("tolerable_clock_skew", 60)),
+            aggregator_auth_tokens=tuple(
+                AuthenticationToken(t["type"], t["token"])
+                for t in body.get("aggregator_auth_tokens", ())),
+            collector_auth_tokens=tuple(
+                AuthenticationToken(t["type"], t["token"])
+                for t in body.get("collector_auth_tokens", ())),
+        )
+        try:
+            self.datastore.run_tx(
+                "peer_put", lambda tx: tx.put_taskprov_peer_aggregator(peer))
+        except MutationTargetAlreadyExists as e:
+            raise ApiError(409, "peer already exists") from e
+        return {"endpoint": peer.endpoint, "role": peer.role.name.title()}
+
+    def delete_taskprov_peer(self, body: dict) -> None:
+        try:
+            self.datastore.run_tx(
+                "peer_del", lambda tx: tx.delete_taskprov_peer_aggregator(
+                    body["endpoint"], Role[body["role"].upper()]))
+        except MutationTargetNotFound:
+            pass
+
+
+_API_ROUTES = [
+    ("GET", re.compile(r"^/$"), "r_config"),
+    ("GET", re.compile(r"^/task_ids$"), "r_task_ids"),
+    ("POST", re.compile(r"^/tasks$"), "r_post_task"),
+    ("GET", re.compile(r"^/tasks/([^/]+)$"), "r_get_task"),
+    ("DELETE", re.compile(r"^/tasks/([^/]+)$"), "r_delete_task"),
+    ("GET", re.compile(r"^/tasks/([^/]+)/metrics/uploads$"), "r_metrics"),
+    ("GET", re.compile(r"^/hpke_configs$"), "r_get_hpke"),
+    ("PUT", re.compile(r"^/hpke_configs$"), "r_put_hpke"),
+    ("PATCH", re.compile(r"^/hpke_configs/(\d+)$"), "r_patch_hpke"),
+    ("DELETE", re.compile(r"^/hpke_configs/(\d+)$"), "r_delete_hpke"),
+    ("GET", re.compile(r"^/taskprov/peer_aggregators$"), "r_get_peers"),
+    ("POST", re.compile(r"^/taskprov/peer_aggregators$"), "r_post_peer"),
+    ("DELETE", re.compile(r"^/taskprov/peer_aggregators$"), "r_delete_peer"),
+]
+
+
+class ApiRouter:
+    def __init__(self, api: AggregatorApi):
+        self.api = api
+
+    def handle(self, method, path, query, body, headers):
+        try:
+            for m_, rx, name in _API_ROUTES:
+                if m_ != method:
+                    continue
+                match = rx.match(path)
+                if match:
+                    self.api.check_auth(headers)
+                    payload = json.loads(body) if body else {}
+                    result = getattr(self, name)(match, query, payload)
+                    status = 200 if result is not None else 204
+                    data = json.dumps(result).encode() if result is not None else b""
+                    return status, data
+            return 404, json.dumps({"detail": "no such route"}).encode()
+        except ApiError as e:
+            return e.status, json.dumps({"detail": e.detail}).encode()
+        except Exception:
+            traceback.print_exc()
+            return 500, json.dumps({"detail": "internal error"}).encode()
+
+    def r_config(self, match, query, body):
+        return self.api.get_config()
+
+    def r_task_ids(self, match, query, body):
+        token = query.get("pagination_token", [None])[0]
+        return self.api.get_task_ids(token)
+
+    def r_post_task(self, match, query, body):
+        return self.api.post_task(body)
+
+    def r_get_task(self, match, query, body):
+        return self.api.get_task(TaskId.from_str(match.group(1)))
+
+    def r_delete_task(self, match, query, body):
+        self.api.delete_task(TaskId.from_str(match.group(1)))
+        return None
+
+    def r_metrics(self, match, query, body):
+        return self.api.get_upload_metrics(TaskId.from_str(match.group(1)))
+
+    def r_get_hpke(self, match, query, body):
+        return self.api.get_hpke_configs()
+
+    def r_put_hpke(self, match, query, body):
+        return self.api.put_hpke_config(body)
+
+    def r_patch_hpke(self, match, query, body):
+        self.api.patch_hpke_config(int(match.group(1)), body)
+        return None
+
+    def r_delete_hpke(self, match, query, body):
+        self.api.delete_hpke_config(int(match.group(1)))
+        return None
+
+    def r_get_peers(self, match, query, body):
+        return self.api.get_taskprov_peers()
+
+    def r_post_peer(self, match, query, body):
+        return self.api.post_taskprov_peer(body)
+
+    def r_delete_peer(self, match, query, body):
+        self.api.delete_taskprov_peer(body)
+        return None
+
+
+class AggregatorApiServer:
+    """Standalone HTTP server for the operator API (the reference can also
+    mount it under a path prefix of the DAP server — binaries/aggregator.rs:100)."""
+
+    def __init__(self, api: AggregatorApi, host: str = "127.0.0.1", port: int = 0):
+        router = ApiRouter(api)
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _run(self, method):
+                parsed = urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, data = router.handle(method, parsed.path,
+                                             parse_qs(parsed.query), body,
+                                             self.headers)
+                self.send_response(status)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_PUT(self):
+                self._run("PUT")
+
+            def do_PATCH(self):
+                self._run("PATCH")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "AggregatorApiServer":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,231 @@
+"""Service binaries + the `janus_main` harness
+(reference aggregator/src/binary_utils.rs:243, binaries/*.rs, bin/*.rs).
+
+Entry points (python -m janus_tpu.binaries <service> --config-file ...):
+    aggregator              DAP HTTP server (+ optional operator API + GC loop)
+    aggregation_job_creator leader daemon
+    aggregation_job_driver  leader daemon
+    collection_job_driver   leader daemon
+
+Secrets come from CLI/env (--datastore-keys / JANUS_DATASTORE_KEYS), never
+the config file.  SIGTERM/SIGINT shut down gracefully.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import os
+import signal
+import sys
+import threading
+
+from janus_tpu.config import (
+    AggregatorBinaryConfig,
+    CreatorBinaryConfig,
+    DriverBinaryConfig,
+    load_config,
+)
+from janus_tpu.core.time import RealClock
+from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
+
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def build_datastore(common, datastore_keys: list[str] | None) -> Datastore:
+    """reference binary_utils.rs:57,128."""
+    keys_b64 = datastore_keys or []
+    if not keys_b64 and os.environ.get("JANUS_DATASTORE_KEYS"):
+        keys_b64 = os.environ["JANUS_DATASTORE_KEYS"].split(",")
+    if not keys_b64:
+        raise SystemExit("no datastore keys provided "
+                         "(--datastore-keys or JANUS_DATASTORE_KEYS)")
+    keys = [base64.urlsafe_b64decode(k + "=" * (-len(k) % 4)) for k in keys_b64]
+    url = common.database.url
+    path = None if url in (":memory:", "") else url.removeprefix("sqlite://")
+    backend = SqliteBackend(path)
+    ds = Datastore(backend, Crypter(keys), RealClock(),
+                   max_transaction_retries=common.max_transaction_retries)
+    try:
+        ds.check_schema_version()
+    except Exception:
+        try:
+            ds.migrate()  # older on-disk schema: apply incremental migrations
+            ds.check_schema_version()
+        except Exception:
+            ds.put_schema()  # fresh database
+    ds.check_schema_version()
+    return ds
+
+
+def janus_main(argv, config_cls, run):
+    """Parse options, load config, build datastore, run under a stop event
+    (reference binary_utils.rs:243)."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config-file", required=True)
+    parser.add_argument("--datastore-keys", action="append", default=None)
+    args = parser.parse_args(argv)
+    cfg = load_config(config_cls, args.config_file)
+    ds = build_datastore(cfg.common, args.datastore_keys)
+    health = None
+    if cfg.common.health_check_listen_address:
+        from janus_tpu.health import HealthServer
+
+        hhost, hport = _parse_addr(cfg.common.health_check_listen_address)
+        try:
+            health = HealthServer(hhost, hport).start()
+        except OSError:
+            health = None  # port in use: health listener is best-effort
+    stop = threading.Event()
+
+    def _sig(_signo, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        run(cfg, ds, stop)
+    finally:
+        if health is not None:
+            health.stop()
+
+
+# -- services ---------------------------------------------------------------
+
+
+def run_aggregator(cfg: AggregatorBinaryConfig, ds: Datastore,
+                   stop: threading.Event) -> None:
+    """reference binaries/aggregator.rs:44."""
+    from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+    from janus_tpu.aggregator.garbage_collector import GarbageCollector
+
+    agg = Aggregator(ds, ds.clock, AggregatorConfig(
+        max_upload_batch_size=cfg.max_upload_batch_size,
+        max_upload_batch_write_delay_ms=cfg.max_upload_batch_write_delay_ms,
+        batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
+        taskprov_enabled=cfg.taskprov.enabled,
+    ))
+    host, port = _parse_addr(cfg.listen_address)
+    server = DapHttpServer(agg, host, port).start()
+    print(f"aggregator listening on {server.address}", flush=True)
+
+    api_server = None
+    if cfg.aggregator_api_listen_address:
+        from janus_tpu.aggregator_api import AggregatorApi, AggregatorApiServer
+        from janus_tpu.core.auth_tokens import AuthenticationToken
+
+        tokens = [AuthenticationToken.bearer(t) for t in
+                  os.environ.get("JANUS_AGGREGATOR_API_AUTH_TOKENS", "").split(",")
+                  if t]
+        ahost, aport = _parse_addr(cfg.aggregator_api_listen_address)
+        api_server = AggregatorApiServer(
+            AggregatorApi(ds, tokens), ahost, aport).start()
+        print(f"aggregator API listening on {api_server.address}", flush=True)
+
+    gc_thread = None
+    if cfg.garbage_collection_interval_s:
+        gc = GarbageCollector(ds)
+
+        def gc_loop():
+            while not stop.wait(cfg.garbage_collection_interval_s):
+                try:
+                    gc.run_once()
+                except Exception as e:  # keep the daemon alive
+                    print(f"gc error: {e}", file=sys.stderr, flush=True)
+
+        gc_thread = threading.Thread(target=gc_loop, daemon=True)
+        gc_thread.start()
+
+    stop.wait()
+    server.stop()
+    if api_server:
+        api_server.stop()
+
+
+def run_aggregation_job_creator(cfg: CreatorBinaryConfig, ds: Datastore,
+                                stop: threading.Event) -> None:
+    from janus_tpu.aggregator.aggregation_job_creator import AggregationJobCreator
+
+    creator = AggregationJobCreator(
+        ds,
+        min_aggregation_job_size=cfg.min_aggregation_job_size,
+        max_aggregation_job_size=cfg.max_aggregation_job_size,
+        tasks_update_frequency_s=cfg.tasks_update_frequency_s,
+        batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
+    )
+    t = threading.Thread(target=creator.run, daemon=True)
+    t.start()
+    stop.wait()
+    creator.stop()
+    t.join(timeout=10)
+
+
+def _run_job_driver(make_driver, cfg: DriverBinaryConfig, ds: Datastore,
+                    stop: threading.Event) -> None:
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+
+    driver = make_driver(cfg, ds)
+    jd = JobDriver(
+        JobDriverConfig(
+            job_discovery_interval_s=cfg.job_driver.job_discovery_interval_s,
+            max_concurrent_job_workers=cfg.job_driver.max_concurrent_job_workers,
+            lease_duration_s=cfg.job_driver.worker_lease_duration_s,
+            maximum_attempts_before_failure=(
+                cfg.job_driver.maximum_attempts_before_failure),
+        ),
+        driver.acquirer, driver.stepper)
+    t = threading.Thread(target=jd.run, daemon=True)
+    t.start()
+    stop.wait()
+    jd.stop()
+    t.join(timeout=10)
+
+
+def run_aggregation_job_driver(cfg, ds, stop) -> None:
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+
+    _run_job_driver(
+        lambda c, d: AggregationJobDriver(
+            d, batch_aggregation_shard_count=c.batch_aggregation_shard_count,
+            maximum_attempts_before_failure=(
+                c.job_driver.maximum_attempts_before_failure),
+            lease_duration_s=c.job_driver.worker_lease_duration_s),
+        cfg, ds, stop)
+
+
+def run_collection_job_driver(cfg, ds, stop) -> None:
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+
+    _run_job_driver(
+        lambda c, d: CollectionJobDriver(
+            d,
+            maximum_attempts_before_failure=(
+                c.job_driver.maximum_attempts_before_failure),
+            lease_duration_s=c.job_driver.worker_lease_duration_s),
+        cfg, ds, stop)
+
+
+SERVICES = {
+    "aggregator": (AggregatorBinaryConfig, run_aggregator),
+    "aggregation_job_creator": (CreatorBinaryConfig, run_aggregation_job_creator),
+    "aggregation_job_driver": (DriverBinaryConfig, run_aggregation_job_driver),
+    "collection_job_driver": (DriverBinaryConfig, run_collection_job_driver),
+}
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] not in SERVICES:
+        print(f"usage: python -m janus_tpu.binaries <{'|'.join(SERVICES)}> "
+              "--config-file FILE [--datastore-keys KEY...]", file=sys.stderr)
+        return 2
+    config_cls, run = SERVICES[argv[0]]
+    janus_main(argv[1:], config_cls, run)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

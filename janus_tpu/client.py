@@ -84,7 +84,7 @@ class Client:
 
     # -- report preparation (reference lib.rs:390,424) ---------------------
 
-    def prepare_report(self, measurement, time=None) -> Report:
+    def prepare_report(self, measurement, time=None, extensions=()) -> Report:
         self._ensure_configs()
         report_id = ReportId(os.urandom(ReportId.SIZE))
         t = (time if time is not None else self.clock.now()).round_down(
@@ -102,15 +102,16 @@ class Client:
             (Role.HELPER, self.helper_hpke_config, input_shares[1]),
         ):
             plaintext = PlaintextInputShare(
-                (), self.vdaf.encode_input_share(role.index(), share)).encode()
+                tuple(extensions),
+                self.vdaf.encode_input_share(role.index(), share)).encode()
             encrypted.append(hpke.seal(
                 config,
                 hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT, role),
                 plaintext, aad))
         return Report(metadata, encoded_public, encrypted[0], encrypted[1])
 
-    def upload(self, measurement, time=None) -> Report:
-        report = self.prepare_report(measurement, time)
+    def upload(self, measurement, time=None, extensions=()) -> Report:
+        report = self.prepare_report(measurement, time, extensions)
         url = (self.params.leader_endpoint.rstrip("/")
                + f"/tasks/{self.params.task_id}/reports")
         resp = self._session_or_new().put(

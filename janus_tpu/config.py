@@ -1,0 +1,116 @@
+"""Layered configuration: YAML config file + env/CLI overrides
+(reference aggregator/src/config.rs:31,74,124,164 and binary_utils.rs:201).
+
+Each service binary loads a YAML document with a `common` section plus
+binary-specific sections; secrets (datastore keys, auth tokens) come from
+CLI options or environment variables, never the config file.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import yaml
+
+
+@dataclass
+class DbConfig:
+    """reference config.rs:74."""
+
+    url: str = ":memory:"  # sqlite path, or "sqlite:///path"; ":memory:" for tests
+    connection_pool_timeout_s: int = 60
+
+
+@dataclass
+class CommonConfig:
+    """reference config.rs:31."""
+
+    database: DbConfig = field(default_factory=DbConfig)
+    health_check_listen_address: str = "127.0.0.1:9001"
+    max_transaction_retries: int = 10
+    logging_level: str = "info"
+
+
+@dataclass
+class TaskprovConfig:
+    """reference config.rs:124."""
+
+    enabled: bool = False
+    ignore_unknown_differential_privacy_mechanism: bool = False
+
+
+@dataclass
+class JobDriverBinaryConfig:
+    """reference config.rs:164."""
+
+    job_discovery_interval_s: float = 10.0
+    max_concurrent_job_workers: int = 10
+    worker_lease_duration_s: int = 600
+    worker_lease_clock_skew_allowance_s: int = 60
+    maximum_attempts_before_failure: int = 10
+    retry_initial_interval_ms: int = 1000
+    retry_max_interval_ms: int = 30_000
+    retry_max_elapsed_time_ms: int = 300_000
+
+
+@dataclass
+class AggregatorBinaryConfig:
+    """reference binaries/aggregator.rs:327."""
+
+    common: CommonConfig = field(default_factory=CommonConfig)
+    listen_address: str = "127.0.0.1:8080"
+    max_upload_batch_size: int = 100
+    max_upload_batch_write_delay_ms: int = 250
+    batch_aggregation_shard_count: int = 32
+    taskprov: TaskprovConfig = field(default_factory=TaskprovConfig)
+    garbage_collection_interval_s: float | None = None
+    aggregator_api_listen_address: str | None = None
+
+
+@dataclass
+class CreatorBinaryConfig:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    tasks_update_frequency_s: float = 10.0
+    aggregation_job_creation_interval_s: float = 10.0
+    min_aggregation_job_size: int = 10
+    max_aggregation_job_size: int = 100
+    batch_aggregation_shard_count: int = 32
+
+
+@dataclass
+class DriverBinaryConfig:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    job_driver: JobDriverBinaryConfig = field(default_factory=JobDriverBinaryConfig)
+    batch_aggregation_shard_count: int = 32
+
+
+def _build(cls, obj):
+    """Recursively construct a dataclass from a mapping, rejecting unknown
+    keys (parse-strictness like serde's deny_unknown_fields)."""
+    if obj is None:
+        return cls()
+    fields = cls.__dataclass_fields__
+    unknown = set(obj) - set(fields)
+    if unknown:
+        raise ValueError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs = {}
+    for name, value in obj.items():
+        ftype = fields[name].type
+        nested = {
+            "DbConfig": DbConfig, "CommonConfig": CommonConfig,
+            "TaskprovConfig": TaskprovConfig,
+            "JobDriverBinaryConfig": JobDriverBinaryConfig,
+        }.get(ftype if isinstance(ftype, str) else getattr(ftype, "__name__", ""))
+        kwargs[name] = _build(nested, value) if nested and isinstance(value, dict) \
+            else value
+    return cls(**kwargs)
+
+
+def load_config(cls, path: str):
+    with open(path) as f:
+        obj = yaml.safe_load(f) or {}
+    return _build(cls, obj)
+
+
+def loads_config(cls, text: str):
+    return _build(cls, yaml.safe_load(text) or {})

@@ -31,7 +31,7 @@ from janus_tpu.core.auth_tokens import AuthenticationToken, AuthenticationTokenH
 from janus_tpu.core.hpke import HpkeKeypair
 from janus_tpu.core.time import Clock
 from janus_tpu.datastore import models as m
-from janus_tpu.datastore.schema import SCHEMA_VERSION, TABLES
+from janus_tpu.datastore.schema import MIGRATIONS, SCHEMA_VERSION, TABLES
 from janus_tpu.datastore.task import AggregatorTask, QueryTypeCfg
 from janus_tpu.messages import (
     AggregationJobId,
@@ -140,6 +140,12 @@ class SqliteBackend:
 # ---------------------------------------------------------------------------
 
 
+def _metric_tx_retry(name: str) -> None:
+    from janus_tpu.metrics import tx_retry_counter
+
+    tx_retry_counter.add(1, tx=name)
+
+
 class Datastore:
     def __init__(self, backend: SqliteBackend, crypter: Crypter, clock: Clock,
                  max_transaction_retries: int = 10):
@@ -162,6 +168,22 @@ class Datastore:
                     conn.execute(ddl)
                 conn.execute("INSERT INTO schema_version (version) VALUES (?)",
                              (SCHEMA_VERSION,))
+        finally:
+            conn.close()
+
+    def migrate(self) -> None:
+        """Upgrade an older on-disk schema to SCHEMA_VERSION in-place."""
+        conn = self.backend.connect()
+        try:
+            row = conn.execute("SELECT MAX(version) FROM schema_version").fetchone()
+            current = row[0] if row and row[0] is not None else 0
+            with conn:
+                for version in range(current + 1, SCHEMA_VERSION + 1):
+                    for ddl in MIGRATIONS.get(version, ()):
+                        conn.execute(ddl)
+                    conn.execute(
+                        "INSERT INTO schema_version (version) VALUES (?)",
+                        (version,))
         finally:
             conn.close()
 
@@ -191,12 +213,14 @@ class Datastore:
                     conn.rollback()
                     if "locked" in str(e) or "busy" in str(e):
                         self.tx_retry_count += 1
+                        _metric_tx_retry(name)
                         last = SerializationConflict(str(e))
                     else:
                         raise DatastoreError(str(e)) from e
                 except SerializationConflict as e:
                     conn.rollback()
                     self.tx_retry_count += 1
+                    _metric_tx_retry(name)
                     last = e
                 except Exception:
                     conn.rollback()
@@ -265,8 +289,9 @@ class Transaction:
                     peer_aggregator_endpoint, query_type, vdaf, vdaf_verify_key,
                     task_expiration, report_expiry_age, min_batch_size,
                     time_precision, tolerable_clock_skew, collector_hpke_config,
-                    aggregator_auth_token, collector_auth_token, created_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                    aggregator_auth_token, collector_auth_token, taskprov,
+                    created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
                 (
                     tid, int(task.role), task.peer_aggregator_endpoint,
                     json.dumps(task.query_type.to_json_obj()),
@@ -277,7 +302,7 @@ class Transaction:
                     task.tolerable_clock_skew.seconds,
                     task.collector_hpke_config.encode()
                     if task.collector_hpke_config else None,
-                    agg_tok, col_tok, self._now(),
+                    agg_tok, col_tok, 1 if task.taskprov else 0, self._now(),
                 ),
             )
         except sqlite3.IntegrityError as e:
@@ -298,7 +323,7 @@ class Transaction:
                       vdaf_verify_key, task_expiration, report_expiry_age,
                       min_batch_size, time_precision, tolerable_clock_skew,
                       collector_hpke_config, aggregator_auth_token,
-                      collector_auth_token
+                      collector_auth_token, taskprov
                FROM tasks WHERE task_id = ?""",
             (tid,),
         ).fetchone()
@@ -312,7 +337,7 @@ class Transaction:
                       vdaf, vdaf_verify_key, task_expiration, report_expiry_age,
                       min_batch_size, time_precision, tolerable_clock_skew,
                       collector_hpke_config, aggregator_auth_token,
-                      collector_auth_token
+                      collector_auth_token, taskprov
                FROM tasks"""
         ).fetchall()
         return [self._task_from_row(TaskId(r[0]), r[1:]) for r in rows]
@@ -320,7 +345,8 @@ class Transaction:
     def _task_from_row(self, task_id: TaskId, row) -> AggregatorTask:
         tid = bytes(task_id)
         (role, endpoint, qt_json, vdaf_json, vk_enc, expiry, expiry_age, min_bs,
-         precision, skew, collector_cfg, agg_tok_enc, col_tok_enc) = row
+         precision, skew, collector_cfg, agg_tok_enc, col_tok_enc,
+         taskprov) = row
         agg_token = agg_hash = col_hash = None
         if agg_tok_enc is not None:
             obj = json.loads(self.crypter.decrypt(
@@ -353,6 +379,7 @@ class Transaction:
             tolerable_clock_skew=Duration(skew),
             task_expiration=Time(expiry) if expiry is not None else None,
             report_expiry_age=Duration(expiry_age) if expiry_age is not None else None,
+            taskprov=bool(taskprov),
             collector_hpke_config=HpkeConfig.decode(collector_cfg)
             if collector_cfg else None,
             aggregator_auth_token=agg_token,
@@ -1103,6 +1130,92 @@ class Transaction:
             "DELETE FROM outstanding_batches WHERE task_id = ? AND batch_id = ?",
             (bytes(task_id), bytes(batch_id)),
         )
+
+    # -- taskprov peer aggregators (reference datastore.rs:4580) ----------
+
+    def put_taskprov_peer_aggregator(self, peer) -> None:
+        from janus_tpu.taskprov import PeerAggregator  # noqa: F401
+
+        key = peer.endpoint.encode() + bytes([int(peer.role)])
+        tokens = json.dumps([
+            {"type": t.token_type, "token": t.token}
+            for t in peer.aggregator_auth_tokens
+        ]).encode()
+        ctokens = json.dumps([
+            {"type": t.token_type, "token": t.token}
+            for t in peer.collector_auth_tokens
+        ]).encode()
+        try:
+            self._exec(
+                """INSERT INTO taskprov_peer_aggregators (endpoint, peer_role,
+                     verify_key_init, collector_hpke_config, report_expiry_age,
+                     tolerable_clock_skew, aggregator_auth_tokens,
+                     collector_auth_tokens)
+                   VALUES (?,?,?,?,?,?,?,?)""",
+                (peer.endpoint, int(peer.role),
+                 self.crypter.encrypt("taskprov_peer_aggregators", key,
+                                      "verify_key_init", peer.verify_key_init),
+                 peer.collector_hpke_config.encode(),
+                 peer.report_expiry_age.seconds
+                 if peer.report_expiry_age else None,
+                 peer.tolerable_clock_skew.seconds,
+                 self.crypter.encrypt("taskprov_peer_aggregators", key,
+                                      "aggregator_auth_tokens", tokens),
+                 self.crypter.encrypt("taskprov_peer_aggregators", key,
+                                      "collector_auth_tokens", ctokens)),
+            )
+        except sqlite3.IntegrityError as e:
+            raise MutationTargetAlreadyExists(str(e)) from e
+
+    def _peer_from_row(self, row):
+        from janus_tpu.taskprov import PeerAggregator
+
+        endpoint, role, vki, chc, rea, tcs, atoks, ctoks = row
+        key = endpoint.encode() + bytes([role])
+
+        def toks(blob, column):
+            raw = self.crypter.decrypt("taskprov_peer_aggregators", key,
+                                       column, blob)
+            return tuple(AuthenticationToken(t["type"], t["token"])
+                         for t in json.loads(raw))
+
+        return PeerAggregator(
+            endpoint=endpoint, role=Role(role),
+            verify_key_init=self.crypter.decrypt(
+                "taskprov_peer_aggregators", key, "verify_key_init", vki),
+            collector_hpke_config=HpkeConfig.decode(chc),
+            report_expiry_age=Duration(rea) if rea is not None else None,
+            tolerable_clock_skew=Duration(tcs),
+            aggregator_auth_tokens=toks(atoks, "aggregator_auth_tokens"),
+            collector_auth_tokens=toks(ctoks, "collector_auth_tokens"),
+        )
+
+    _PEER_COLS = ("endpoint, peer_role, verify_key_init, collector_hpke_config,"
+                  " report_expiry_age, tolerable_clock_skew,"
+                  " aggregator_auth_tokens, collector_auth_tokens")
+
+    def get_taskprov_peer_aggregator(self, endpoint: str, role: Role):
+        row = self._exec(
+            f"""SELECT {self._PEER_COLS} FROM taskprov_peer_aggregators
+                WHERE endpoint = ? AND peer_role = ?""",
+            (endpoint, int(role)),
+        ).fetchone()
+        return self._peer_from_row(row) if row else None
+
+    def get_taskprov_peer_aggregators(self) -> list:
+        rows = self._exec(
+            f"SELECT {self._PEER_COLS} FROM taskprov_peer_aggregators"
+        ).fetchall()
+        return [self._peer_from_row(r) for r in rows]
+
+    def delete_taskprov_peer_aggregator(self, endpoint: str, role: Role) -> None:
+        cur = self._exec(
+            """DELETE FROM taskprov_peer_aggregators
+               WHERE endpoint = ? AND peer_role = ?""",
+            (endpoint, int(role)),
+        )
+        if cur.rowcount == 0:
+            raise MutationTargetNotFound("no such peer aggregator")
 
     # -- global HPKE keys -------------------------------------------------
 

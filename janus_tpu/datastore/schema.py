@@ -12,7 +12,16 @@ backend applies the same statements with type spellings adjusted
 (BLOB->BYTEA, AUTOINCREMENT->GENERATED ... AS IDENTITY).
 """
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+# Incremental migrations: version N -> statements that upgrade a (N-1)
+# datastore (the analog of the reference's sqlx migration files).  Applied by
+# Datastore.migrate(); migrations must be idempotent-safe on replay failures.
+MIGRATIONS: dict[int, list[str]] = {
+    2: [
+        "ALTER TABLE tasks ADD COLUMN taskprov INTEGER NOT NULL DEFAULT 0",
+    ],
+}
 
 TABLES = [
     # -- global HPKE keys (reference schema :26)
@@ -57,6 +66,7 @@ TABLES = [
         collector_hpke_config BLOB,
         aggregator_auth_token BLOB,        -- encrypted JSON: token (leader) / hash (helper)
         collector_auth_token BLOB,         -- encrypted JSON: hash
+        taskprov INTEGER NOT NULL DEFAULT 0,
         created_at INTEGER NOT NULL
     )
     """,

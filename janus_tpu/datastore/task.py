@@ -87,6 +87,9 @@ class AggregatorTask:
     aggregator_auth_token_hash: AuthenticationTokenHash | None = None
     collector_auth_token_hash: AuthenticationTokenHash | None = None
     hpke_keys: tuple[HpkeKeypair, ...] = ()
+    # In-band provisioned via draft-wang-ppm-dap-taskprov: reports must carry
+    # the taskprov extension, and HPKE uses the global keys.
+    taskprov: bool = False
 
     def __post_init__(self):
         if not self.role.is_aggregator():

@@ -400,10 +400,18 @@ class BatchPrio3:
         fn = self._helper_fn(M)
         nonce_rows = np.zeros((M, 16), dtype=np.uint8)
         nonce_rows[:N] = nonces_arr(nonces)
+        import time as _t
+
+        from janus_tpu.metrics import device_batch_reports, device_batch_seconds
+
+        t0 = _t.monotonic()
         verif_raw, own_part, msg_seed, out_share, proof_ok, jr_ok, fallback = (
             np.asarray(x) for x in fn(vk, seeds, blinds, nonce_rows, pub0,
                                       ljr, lverif)
         )
+        device_batch_seconds.observe(_t.monotonic() - t0, kind="helper_init",
+                                     bucket=M)
+        device_batch_reports.add(N, kind="helper_init")
 
         out: list[PreparedReport] = []
         for i in range(N):

@@ -1,0 +1,270 @@
+"""Taskprov wire types (draft-wang-ppm-dap-taskprov; reference
+messages/src/taskprov.rs:17,133,321,479,514).
+
+In-band task provisioning: the full task configuration travels in the
+`dap-taskprov` request header (base64url of an encoded TaskConfig), and the
+task id is the SHA-256 of those encoded bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from janus_tpu.messages import Duration, Time
+from janus_tpu.messages.codec import (
+    Cursor,
+    DecodeError,
+    WireMessage,
+    opaque8,
+    opaque16,
+    u8,
+    u16,
+    u32,
+)
+
+TASKPROV_HEADER = "dap-taskprov"  # reference core/src/lib.rs:43
+
+
+@dataclass(frozen=True)
+class Url(WireMessage):
+    """u16-length-prefixed URL bytes (reference messages lib.rs:58)."""
+
+    value: bytes
+
+    def encode(self) -> bytes:
+        return opaque16(self.value)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "Url":
+        return cls(cur.opaque16())
+
+    def __str__(self) -> str:
+        return self.value.decode()
+
+
+@dataclass(frozen=True)
+class TaskprovQuery(WireMessage):
+    """Query type + params; redefined from the main module because the type
+    is unknown at decode time (reference taskprov.rs:216)."""
+
+    RESERVED = 0
+    TIME_INTERVAL = 1
+    FIXED_SIZE = 2
+
+    kind: int
+    max_batch_size: int | None = None  # fixed-size only
+
+    def encode(self) -> bytes:
+        if self.kind == self.FIXED_SIZE:
+            return u8(self.kind) + u32(self.max_batch_size)
+        return u8(self.kind)
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "TaskprovQuery":
+        kind = cur.u8()
+        if kind == cls.FIXED_SIZE:
+            return cls(kind, cur.u32())
+        if kind in (cls.RESERVED, cls.TIME_INTERVAL):
+            return cls(kind)
+        raise DecodeError(f"unexpected QueryType value {kind}")
+
+
+@dataclass(frozen=True)
+class QueryConfig(WireMessage):
+    """reference taskprov.rs:133."""
+
+    time_precision: Duration
+    max_batch_query_count: int
+    min_batch_size: int
+    query: TaskprovQuery
+
+    def encode(self) -> bytes:
+        return (self.time_precision.encode() + u16(self.max_batch_query_count)
+                + u32(self.min_batch_size) + self.query.encode())
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "QueryConfig":
+        return cls(Duration.decode_from(cur), cur.u16(), cur.u32(),
+                   TaskprovQuery.decode_from(cur))
+
+
+@dataclass(frozen=True)
+class DpMechanism(WireMessage):
+    """reference taskprov.rs:514."""
+
+    RESERVED = 0
+    NONE = 1
+
+    codepoint: int
+    payload: bytes = b""
+
+    def encode(self) -> bytes:
+        return u8(self.codepoint) + self.payload
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "DpMechanism":
+        codepoint = cur.u8()
+        if codepoint in (cls.RESERVED, cls.NONE):
+            return cls(codepoint)
+        # Unrecognized mechanisms absorb the rest of the payload.
+        return cls(codepoint, cur.take(cur.remaining()))
+
+    @property
+    def is_none(self) -> bool:
+        return self.codepoint == self.NONE
+
+    @property
+    def is_recognized(self) -> bool:
+        return self.codepoint in (self.RESERVED, self.NONE)
+
+
+@dataclass(frozen=True)
+class DpConfig(WireMessage):
+    """reference taskprov.rs:479."""
+
+    dp_mechanism: DpMechanism
+
+    def encode(self) -> bytes:
+        return self.dp_mechanism.encode()
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "DpConfig":
+        return cls(DpMechanism.decode_from(cur))
+
+    @classmethod
+    def none(cls) -> "DpConfig":
+        return cls(DpMechanism(DpMechanism.NONE))
+
+
+@dataclass(frozen=True)
+class VdafType(WireMessage):
+    """u32 type code + parameters (reference taskprov.rs:321)."""
+
+    PRIO3_COUNT = 0x00000000
+    PRIO3_SUM = 0x00000001
+    PRIO3_SUM_VEC = 0x00000002
+    PRIO3_HISTOGRAM = 0x00000003
+    PRIO3_SUM_VEC_FIELD64_MULTIPROOF_HMAC = 0xFFFF1003
+    POPLAR1 = 0x00001000
+
+    code: int
+    bits: int | None = None
+    length: int | None = None
+    chunk_length: int | None = None
+    proofs: int | None = None
+
+    def encode(self) -> bytes:
+        out = u32(self.code)
+        if self.code == self.PRIO3_SUM:
+            out += u8(self.bits)
+        elif self.code == self.PRIO3_SUM_VEC:
+            out += u32(self.length) + u8(self.bits) + u32(self.chunk_length)
+        elif self.code == self.PRIO3_SUM_VEC_FIELD64_MULTIPROOF_HMAC:
+            out += (u32(self.length) + u8(self.bits) + u32(self.chunk_length)
+                    + u8(self.proofs))
+        elif self.code == self.PRIO3_HISTOGRAM:
+            out += u32(self.length) + u32(self.chunk_length)
+        elif self.code == self.POPLAR1:
+            out += u16(self.bits)
+        elif self.code != self.PRIO3_COUNT:
+            raise ValueError(f"unknown VDAF type code {self.code:#x}")
+        return out
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "VdafType":
+        code = cur.u32()
+        if code == cls.PRIO3_COUNT:
+            return cls(code)
+        if code == cls.PRIO3_SUM:
+            return cls(code, bits=cur.u8())
+        if code == cls.PRIO3_SUM_VEC:
+            return cls(code, length=cur.u32(), bits=cur.u8(),
+                       chunk_length=cur.u32())
+        if code == cls.PRIO3_SUM_VEC_FIELD64_MULTIPROOF_HMAC:
+            return cls(code, length=cur.u32(), bits=cur.u8(),
+                       chunk_length=cur.u32(), proofs=cur.u8())
+        if code == cls.PRIO3_HISTOGRAM:
+            return cls(code, length=cur.u32(), chunk_length=cur.u32())
+        if code == cls.POPLAR1:
+            return cls(code, bits=cur.u16())
+        raise DecodeError(f"unexpected VDAF type code value {code}")
+
+    def to_vdaf_instance(self):
+        """-> models.VdafInstance (reference core/src/vdaf.rs TryFrom)."""
+        from janus_tpu.models import VdafInstance
+
+        if self.code == self.PRIO3_COUNT:
+            return VdafInstance.prio3_count()
+        if self.code == self.PRIO3_SUM:
+            return VdafInstance.prio3_sum(self.bits)
+        if self.code == self.PRIO3_SUM_VEC:
+            return VdafInstance.prio3_sum_vec(self.bits, self.length,
+                                              self.chunk_length)
+        if self.code == self.PRIO3_SUM_VEC_FIELD64_MULTIPROOF_HMAC:
+            return VdafInstance.prio3_sum_vec_field64_multiproof_hmac_sha256_aes128(
+                self.proofs, self.bits, self.length, self.chunk_length)
+        if self.code == self.PRIO3_HISTOGRAM:
+            return VdafInstance.prio3_histogram(self.length, self.chunk_length)
+        raise ValueError(f"unsupported taskprov VDAF {self.code:#x}")
+
+
+@dataclass(frozen=True)
+class VdafConfig(WireMessage):
+    """reference taskprov.rs:272."""
+
+    dp_config: DpConfig
+    vdaf_type: VdafType
+
+    def encode(self) -> bytes:
+        return opaque16(self.dp_config.encode()) + self.vdaf_type.encode()
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "VdafConfig":
+        dp = DpConfig.decode(cur.opaque16())
+        return cls(dp, VdafType.decode_from(cur))
+
+
+@dataclass(frozen=True)
+class TaskConfig(WireMessage):
+    """reference taskprov.rs:17."""
+
+    task_info: bytes
+    leader_aggregator_endpoint: Url
+    helper_aggregator_endpoint: Url
+    query_config: QueryConfig
+    task_expiration: Time
+    vdaf_config: VdafConfig
+
+    def __post_init__(self):
+        if not self.task_info:
+            raise ValueError("task_info must not be empty")
+
+    def encode(self) -> bytes:
+        return (opaque8(self.task_info)
+                + self.leader_aggregator_endpoint.encode()
+                + self.helper_aggregator_endpoint.encode()
+                + opaque16(self.query_config.encode())
+                + self.task_expiration.encode()
+                + opaque16(self.vdaf_config.encode()))
+
+    @classmethod
+    def decode_from(cls, cur: Cursor) -> "TaskConfig":
+        task_info = cur.opaque8()
+        if not task_info:
+            raise DecodeError("task_info must not be empty")
+        leader = Url.decode_from(cur)
+        helper = Url.decode_from(cur)
+        query_config = QueryConfig.decode(cur.opaque16())
+        expiration = Time.decode_from(cur)
+        vdaf_config = VdafConfig.decode(cur.opaque16())
+        return cls(task_info, leader, helper, query_config, expiration,
+                   vdaf_config)
+
+    def task_id(self):
+        """Taskprov task id: SHA-256 of the encoded config
+        (reference http_handlers.rs:671)."""
+        import hashlib
+
+        from janus_tpu.messages import TaskId
+
+        return TaskId(hashlib.sha256(self.encode()).digest())

@@ -1,0 +1,147 @@
+"""Process-wide metrics registry with Prometheus text exposition
+(reference aggregator/src/metrics.rs:62-126; key instruments from
+SURVEY.md §5.5: janus_aggregate_step_failure_counter,
+janus_job_acquire_time / janus_job_step_time, datastore tx instruments,
+HTTP request durations).
+
+Dependency-free: counters and histograms are plain atomics behind a lock;
+`exposition()` renders the Prometheus text format, served by the health
+server (janus_tpu.health).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                    2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, value: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def _render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_labelstr(key)} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            counts[bisect_right(self.buckets, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def count(self, **labels) -> int:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return sum(self._counts.get(key, ()))
+
+    def _render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                cum = 0
+                for bound, c in zip(self.buckets, counts):
+                    cum += c
+                    out.append(
+                        f"{self.name}_bucket{_labelstr(key, le=bound)} {cum}")
+                cum += counts[-1]
+                out.append(f'{self.name}_bucket{_labelstr(key, le="+Inf")} {cum}')
+                out.append(f"{self.name}_sum{_labelstr(key)} {self._sums[key]}")
+                out.append(f"{self.name}_count{_labelstr(key)} {cum}")
+        return out
+
+
+def _labelstr(key, le=None) -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            for m_ in self._metrics:
+                if m_.name == name and isinstance(m_, Counter):
+                    return m_
+            c = Counter(name, help_)
+            self._metrics.append(c)
+            return c
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            for m_ in self._metrics:
+                if m_.name == name and isinstance(m_, Histogram):
+                    return m_
+            h = Histogram(name, help_, buckets)
+            self._metrics.append(h)
+            return h
+
+    def exposition(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m_ in metrics:
+            lines.extend(m_._render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# The reference's key instruments (names mirror aggregator.rs:120,
+# job_driver.rs:102-113, datastore.rs:185-207, http_handlers.rs:223).
+aggregate_step_failure_counter = REGISTRY.counter(
+    "janus_aggregate_step_failure",
+    "per-report preparation failures by type")
+upload_decrypt_failure_counter = REGISTRY.counter(
+    "janus_upload_decrypt_failures", "upload HPKE decryption failures")
+upload_decode_failure_counter = REGISTRY.counter(
+    "janus_upload_decode_failures", "upload message decode failures")
+job_acquire_time = REGISTRY.histogram(
+    "janus_job_acquire_time_seconds", "lease acquisition latency")
+job_step_time = REGISTRY.histogram(
+    "janus_job_step_time_seconds", "job step latency")
+tx_retry_counter = REGISTRY.counter(
+    "janus_datastore_tx_retries", "datastore transaction retries")
+http_request_duration = REGISTRY.histogram(
+    "janus_http_request_duration_seconds", "DAP request latency by route/status")
+device_batch_seconds = REGISTRY.histogram(
+    "janus_device_batch_seconds", "device prepare-kernel latency by batch bucket")
+device_batch_reports = REGISTRY.counter(
+    "janus_device_batch_reports", "reports processed by the device engine")

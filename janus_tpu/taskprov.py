@@ -1,0 +1,91 @@
+"""Taskprov runtime state: peer aggregators and VDAF verify-key derivation
+(reference aggregator_core/src/taskprov.rs:17,90,238).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import os
+from dataclasses import dataclass, field
+
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.messages import Duration, HpkeConfig, Role, TaskId
+from janus_tpu.models import VdafInstance
+
+VERIFY_KEY_INIT_LEN = 32
+
+# Fixed HKDF salt from draft-wang-ppm-dap-taskprov
+# (reference aggregator_core/src/taskprov.rs:126-138).
+_TASKPROV_SALT = bytes([
+    0x28, 0xb9, 0xbb, 0x4f, 0x62, 0x4f, 0x67, 0x9a, 0xc1, 0x98, 0xd9, 0x68,
+    0xf4, 0xb0, 0x9e, 0xec, 0x74, 0x01, 0x7a, 0x52, 0xcb, 0x4c, 0xf6, 0x39,
+    0xfb, 0x83, 0xe0, 0x47, 0x72, 0x3a, 0x0f, 0xfe,
+])
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return _hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = _hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def random_verify_key_init() -> bytes:
+    return os.urandom(VERIFY_KEY_INIT_LEN)
+
+
+@dataclass(frozen=True)
+class PeerAggregator:
+    """A taskprov-peered aggregator; (endpoint, role) is the unique key
+    (reference taskprov.rs:90)."""
+
+    endpoint: str
+    role: Role  # the PEER's role
+    verify_key_init: bytes
+    collector_hpke_config: HpkeConfig
+    report_expiry_age: Duration | None
+    tolerable_clock_skew: Duration
+    aggregator_auth_tokens: tuple[AuthenticationToken, ...] = ()
+    collector_auth_tokens: tuple[AuthenticationToken, ...] = ()
+
+    def __post_init__(self):
+        assert len(self.verify_key_init) == VERIFY_KEY_INIT_LEN
+        assert self.role in (Role.LEADER, Role.HELPER)
+
+    def primary_aggregator_auth_token(self) -> AuthenticationToken:
+        return self.aggregator_auth_tokens[-1]
+
+    @staticmethod
+    def _token_matches(a: AuthenticationToken, b: AuthenticationToken) -> bool:
+        # Constant-time compare: these are bearer secrets, and this check
+        # runs on unauthenticated requests (same rationale as
+        # AuthenticationTokenHash.matches).
+        return a.token_type == b.token_type and _hmac.compare_digest(
+            a.token.encode(), b.token.encode())
+
+    def check_aggregator_auth_token(self, token: AuthenticationToken | None) -> bool:
+        return token is not None and any(
+            self._token_matches(t, token)
+            for t in reversed(self.aggregator_auth_tokens))
+
+    def check_collector_auth_token(self, token: AuthenticationToken | None) -> bool:
+        return token is not None and any(
+            self._token_matches(t, token)
+            for t in reversed(self.collector_auth_tokens))
+
+    def derive_vdaf_verify_key(self, task_id: TaskId,
+                               vdaf_instance: VdafInstance) -> bytes:
+        """HKDF-SHA256: extract with the taskprov salt over verify_key_init,
+        expand with the task id (reference taskprov.rs:238)."""
+        prk = _hkdf_extract(_TASKPROV_SALT, self.verify_key_init)
+        return _hkdf_expand(prk, bytes(task_id),
+                            vdaf_instance.verify_key_length)

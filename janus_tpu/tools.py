@@ -1,0 +1,245 @@
+"""Operator CLI tools (reference aggregator/src/bin/janus_cli.rs:58 and
+tools/src/bin/{collect,dap_decode,hpke_keygen}.rs).
+
+    python -m janus_tpu.tools write-schema --db PATH
+    python -m janus_tpu.tools provision-tasks --db PATH --datastore-keys K TASKS.yaml
+    python -m janus_tpu.tools create-datastore-key
+    python -m janus_tpu.tools hpke-keygen [--id N]
+    python -m janus_tpu.tools dap-decode --media-type TYPE FILE
+    python -m janus_tpu.tools collect --task-id .. --leader URL ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+
+import yaml
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _open_datastore(db: str, keys: list[str]):
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
+
+    crypter = Crypter([_unb64(k) for k in keys])
+    return Datastore(SqliteBackend(db), crypter, RealClock())
+
+
+def cmd_write_schema(args) -> int:
+    from janus_tpu.datastore.schema import SCHEMA_VERSION
+
+    ds = _open_datastore(args.db, [_b64(b"\0" * 16)])
+    ds.put_schema()
+    print(f"schema v{SCHEMA_VERSION} written to {args.db}")
+    return 0
+
+
+def cmd_create_datastore_key(args) -> int:
+    import os
+
+    print(_b64(os.urandom(16)))
+    return 0
+
+
+def cmd_provision_tasks(args) -> int:
+    """Load tasks from YAML into the datastore (reference janus_cli.rs:160)."""
+    from janus_tpu.core.auth_tokens import (
+        AuthenticationToken,
+        AuthenticationTokenHash,
+    )
+    from janus_tpu.core.hpke import HpkeKeypair
+    from janus_tpu.datastore.datastore import MutationTargetAlreadyExists
+    from janus_tpu.datastore.task import AggregatorTask, QueryTypeCfg
+    from janus_tpu.messages import Duration, HpkeConfig, Role, TaskId, Time
+    from janus_tpu.models import VdafInstance
+
+    ds = _open_datastore(args.db, args.datastore_keys)
+    with open(args.tasks_file) as f:
+        docs = yaml.safe_load(f)
+    written = 0
+    for doc in docs:
+        role = Role[doc["role"].upper()]
+        agg_token = agg_hash = col_hash = None
+        if "aggregator_auth_token" in doc:
+            t = doc["aggregator_auth_token"]
+            token = AuthenticationToken(t.get("type", "Bearer"), t["token"])
+            if role is Role.LEADER:
+                agg_token = token
+            else:
+                agg_hash = AuthenticationTokenHash.of(token)
+        if "collector_auth_token" in doc:
+            t = doc["collector_auth_token"]
+            col_hash = AuthenticationTokenHash.of(
+                AuthenticationToken(t.get("type", "Bearer"), t["token"]))
+        hpke_keys = []
+        for k in doc.get("hpke_keys", ()):
+            hpke_keys.append(HpkeKeypair(HpkeConfig.decode(_unb64(k["config"])),
+                                         _unb64(k["private_key"])))
+        if not hpke_keys:
+            hpke_keys = [HpkeKeypair.generate(1)]
+        task = AggregatorTask(
+            task_id=TaskId.from_str(doc["task_id"]),
+            peer_aggregator_endpoint=doc["peer_aggregator_endpoint"],
+            query_type=QueryTypeCfg.from_json_obj(doc["query_type"]),
+            vdaf=VdafInstance.from_json_obj(doc["vdaf"]),
+            role=role,
+            vdaf_verify_key=_unb64(doc["vdaf_verify_key"]),
+            min_batch_size=doc["min_batch_size"],
+            time_precision=Duration(doc["time_precision"]),
+            tolerable_clock_skew=Duration(doc.get("tolerable_clock_skew", 60)),
+            task_expiration=(Time(doc["task_expiration"])
+                             if doc.get("task_expiration") else None),
+            report_expiry_age=(Duration(doc["report_expiry_age"])
+                               if doc.get("report_expiry_age") else None),
+            collector_hpke_config=(
+                HpkeConfig.decode(_unb64(doc["collector_hpke_config"]))
+                if doc.get("collector_hpke_config") else None),
+            aggregator_auth_token=agg_token,
+            aggregator_auth_token_hash=agg_hash,
+            collector_auth_token_hash=col_hash,
+            hpke_keys=tuple(hpke_keys),
+        )
+        try:
+            ds.run_tx("provision", lambda tx: tx.put_aggregator_task(task))
+            written += 1
+        except MutationTargetAlreadyExists:
+            print(f"task {task.task_id} already exists, skipping",
+                  file=sys.stderr)
+    print(f"provisioned {written} task(s)")
+    return 0
+
+
+def cmd_hpke_keygen(args) -> int:
+    """reference tools/src/bin/hpke_keygen.rs."""
+    from janus_tpu.core.hpke import HpkeKeypair
+
+    kp = HpkeKeypair.generate(args.id)
+    print(json.dumps({
+        "config": _b64(kp.config.encode()),
+        "private_key": _b64(kp.private_key),
+        "config_id": args.id,
+    }, indent=2))
+    return 0
+
+
+_MEDIA_TYPES = {
+    "hpke-config-list": "HpkeConfigList",
+    "report": "Report",
+    "aggregation-job-init-req": "AggregationJobInitializeReq",
+    "aggregation-job-continue-req": "AggregationJobContinueReq",
+    "aggregation-job-resp": "AggregationJobResp",
+    "aggregate-share-req": "AggregateShareReq",
+    "aggregate-share": "AggregateShare",
+    "collect-req": "CollectionReq",
+    "collection": "Collection",
+}
+
+
+def cmd_dap_decode(args) -> int:
+    """Decode any DAP message from bytes (reference tools/src/bin/dap_decode.rs)."""
+    import janus_tpu.messages as messages
+
+    cls = getattr(messages, _MEDIA_TYPES[args.media_type])
+    data = sys.stdin.buffer.read() if args.file == "-" else open(args.file, "rb").read()
+    msg = cls.decode(data)
+    print(msg)
+    return 0
+
+
+def cmd_collect(args) -> int:
+    """Full collector frontend (reference tools/src/bin/collect.rs)."""
+    from janus_tpu.collector import Collector
+    from janus_tpu.core.auth_tokens import AuthenticationToken
+    from janus_tpu.core.hpke import HpkeKeypair
+    from janus_tpu.messages import (
+        Duration,
+        FixedSizeQuery,
+        HpkeConfig,
+        Interval,
+        Query,
+        TaskId,
+        Time,
+        BatchId,
+    )
+    from janus_tpu.models import VdafInstance
+
+    keypair = HpkeKeypair(HpkeConfig.decode(_unb64(args.hpke_config)),
+                          _unb64(args.hpke_private_key))
+    collector = Collector(
+        TaskId.from_str(args.task_id), args.leader,
+        AuthenticationToken.bearer(args.authorization_bearer_token),
+        keypair, VdafInstance.from_json_obj(json.loads(args.vdaf)))
+    if args.batch_interval_start is not None:
+        query = Query.time_interval(Interval(
+            Time(args.batch_interval_start),
+            Duration(args.batch_interval_duration)))
+    elif args.batch_id:
+        query = Query.fixed_size(FixedSizeQuery(
+            FixedSizeQuery.BY_BATCH_ID, BatchId(_unb64(args.batch_id))))
+    else:
+        query = Query.fixed_size(FixedSizeQuery(FixedSizeQuery.CURRENT_BATCH))
+    result = collector.collect(query, timeout_s=args.timeout)
+    print(json.dumps({
+        "report_count": result.report_count,
+        "interval_start": result.interval.start.seconds,
+        "interval_duration": result.interval.duration.seconds,
+        "aggregate_result": result.aggregate_result,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="janus_tpu.tools")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("write-schema")
+    p.add_argument("--db", required=True)
+    p.set_defaults(fn=cmd_write_schema)
+
+    p = sub.add_parser("create-datastore-key")
+    p.set_defaults(fn=cmd_create_datastore_key)
+
+    p = sub.add_parser("provision-tasks")
+    p.add_argument("--db", required=True)
+    p.add_argument("--datastore-keys", action="append", required=True)
+    p.add_argument("tasks_file")
+    p.set_defaults(fn=cmd_provision_tasks)
+
+    p = sub.add_parser("hpke-keygen")
+    p.add_argument("--id", type=int, default=1)
+    p.set_defaults(fn=cmd_hpke_keygen)
+
+    p = sub.add_parser("dap-decode")
+    p.add_argument("--media-type", required=True, choices=sorted(_MEDIA_TYPES))
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_dap_decode)
+
+    p = sub.add_parser("collect")
+    p.add_argument("--task-id", required=True)
+    p.add_argument("--leader", required=True)
+    p.add_argument("--vdaf", required=True, help='JSON, e.g. \'"Prio3Count"\' or \'{"Prio3Sum": {"bits": 8}}\'')
+    p.add_argument("--authorization-bearer-token", required=True)
+    p.add_argument("--hpke-config", required=True)
+    p.add_argument("--hpke-private-key", required=True)
+    p.add_argument("--batch-interval-start", type=int)
+    p.add_argument("--batch-interval-duration", type=int)
+    p.add_argument("--batch-id")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.set_defaults(fn=cmd_collect)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""Operator REST API (reference aggregator_api/src/routes.rs)."""
+
+import base64
+import hashlib
+
+import requests
+
+from janus_tpu.aggregator_api import AggregatorApi, AggregatorApiServer
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.datastore import ephemeral_datastore
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def test_aggregator_api_end_to_end():
+    ds = ephemeral_datastore(MockClock())
+    token = AuthenticationToken.random_bearer()
+    api = AggregatorApi(ds, [token], public_dap_url="https://dap.example.com/")
+    server = AggregatorApiServer(api).start()
+    sess = requests.Session()
+    auth = {"Authorization": f"Bearer {token.token}"}
+    try:
+        # auth required
+        assert sess.get(f"{server.address}/").status_code == 401
+        r = sess.get(f"{server.address}/", headers=auth)
+        assert r.status_code == 200 and r.json()["protocol"] == "DAP-09"
+
+        # create a leader task
+        verify_key = bytes(range(16))
+        collector_config = HpkeKeypair.generate(9).config
+        req = {
+            "role": "Leader",
+            "vdaf": {"Prio3Sum": {"bits": 8}},
+            "vdaf_verify_key": _b64(verify_key),
+            "query_type": "TimeInterval",
+            "peer_aggregator_endpoint": "https://helper.example.com/",
+            "min_batch_size": 10,
+            "time_precision": 3600,
+            "aggregator_auth_token": {"type": "Bearer", "token": "agg-token"},
+            "collector_auth_token_hash": _b64(hashlib.sha256(b"col").digest()),
+            "collector_hpke_config": _b64(collector_config.encode()),
+        }
+        r = sess.post(f"{server.address}/tasks", json=req, headers=auth)
+        assert r.status_code == 200, r.content
+        task = r.json()
+        assert task["task_id"] == _b64(hashlib.sha256(verify_key).digest())
+        assert task["vdaf"] == {"Prio3Sum": {"bits": 8}}
+
+        # list / get / metrics / delete
+        r = sess.get(f"{server.address}/task_ids", headers=auth)
+        assert task["task_id"] in r.json()["task_ids"]
+        r = sess.get(f"{server.address}/tasks/{task['task_id']}", headers=auth)
+        assert r.status_code == 200 and r.json()["min_batch_size"] == 10
+        r = sess.get(f"{server.address}/tasks/{task['task_id']}/metrics/uploads",
+                     headers=auth)
+        assert r.status_code == 200 and r.json()["report_success"] == 0
+        assert sess.delete(f"{server.address}/tasks/{task['task_id']}",
+                           headers=auth).status_code == 204
+        assert sess.get(f"{server.address}/tasks/{task['task_id']}",
+                        headers=auth).status_code == 404
+
+        # global HPKE config lifecycle
+        r = sess.put(f"{server.address}/hpke_configs", json={}, headers=auth)
+        assert r.status_code == 200
+        config_id = r.json()["config_id"]
+        r = sess.patch(f"{server.address}/hpke_configs/{config_id}",
+                       json={"state": "ACTIVE"}, headers=auth)
+        assert r.status_code == 204
+        r = sess.get(f"{server.address}/hpke_configs", headers=auth)
+        assert any(c["config_id"] == config_id and c["state"] == "ACTIVE"
+                   for c in r.json())
+        assert sess.delete(f"{server.address}/hpke_configs/{config_id}",
+                           headers=auth).status_code == 204
+
+        # taskprov peer lifecycle
+        peer_req = {
+            "endpoint": "https://leader.example.com/",
+            "role": "Leader",
+            "verify_key_init": _b64(bytes(32)),
+            "collector_hpke_config": _b64(collector_config.encode()),
+            "tolerable_clock_skew": 60,
+            "aggregator_auth_tokens": [{"type": "Bearer", "token": "t1"}],
+        }
+        r = sess.post(f"{server.address}/taskprov/peer_aggregators",
+                      json=peer_req, headers=auth)
+        assert r.status_code == 200, r.content
+        r = sess.get(f"{server.address}/taskprov/peer_aggregators", headers=auth)
+        assert len(r.json()) == 1
+        r = sess.delete(f"{server.address}/taskprov/peer_aggregators",
+                        json={"endpoint": peer_req["endpoint"], "role": "Leader"},
+                        headers=auth)
+        assert r.status_code == 204
+    finally:
+        server.stop()

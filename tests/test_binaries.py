@@ -1,0 +1,122 @@
+"""Process-level tests: config parsing, CLI tools, and a real aggregator
+service spawned as a subprocess + graceful SIGTERM shutdown
+(reference tools/tests/cli.rs, aggregator/tests/integration/graceful_shutdown.rs)."""
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from janus_tpu.config import (
+    AggregatorBinaryConfig,
+    CreatorBinaryConfig,
+    loads_config,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def test_config_parsing():
+    cfg = loads_config(AggregatorBinaryConfig, """
+common:
+  database:
+    url: /tmp/janus.db
+  max_transaction_retries: 5
+listen_address: 127.0.0.1:8999
+batch_aggregation_shard_count: 8
+taskprov:
+  enabled: true
+""")
+    assert cfg.common.database.url == "/tmp/janus.db"
+    assert cfg.common.max_transaction_retries == 5
+    assert cfg.listen_address == "127.0.0.1:8999"
+    assert cfg.taskprov.enabled
+    with pytest.raises(ValueError, match="unknown config keys"):
+        loads_config(CreatorBinaryConfig, "bogus_key: 1\n")
+
+
+def test_cli_tools(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(*args, input_=None):
+        return subprocess.run(
+            [sys.executable, "-m", "janus_tpu.tools", *args],
+            capture_output=True, cwd=REPO, env=env, input=input_, timeout=120)
+
+    r = run("create-datastore-key")
+    assert r.returncode == 0
+    key = r.stdout.decode().strip()
+    assert len(base64.urlsafe_b64decode(key + "==")) == 16
+
+    r = run("hpke-keygen", "--id", "7")
+    assert r.returncode == 0
+    keygen = json.loads(r.stdout)
+    assert keygen["config_id"] == 7
+
+    db = str(tmp_path / "janus.db")
+    r = run("write-schema", "--db", db)
+    assert r.returncode == 0, r.stderr
+
+    tasks_yaml = tmp_path / "tasks.yaml"
+    tasks_yaml.write_text(f"""
+- task_id: {_b64(bytes(32))}
+  role: Helper
+  peer_aggregator_endpoint: https://leader.example.com/
+  query_type: TimeInterval
+  vdaf: Prio3Count
+  vdaf_verify_key: {_b64(bytes(16))}
+  min_batch_size: 10
+  time_precision: 3600
+  aggregator_auth_token:
+    token: the-token
+  collector_hpke_config: {keygen["config"]}
+""")
+    r = run("provision-tasks", "--db", db, "--datastore-keys", key,
+            str(tasks_yaml))
+    assert r.returncode == 0, r.stderr
+    assert b"provisioned 1 task(s)" in r.stdout
+
+
+def test_aggregator_binary_serves_and_shuts_down(tmp_path):
+    key = _b64(os.urandom(16))
+    db = str(tmp_path / "svc.db")
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(f"""
+common:
+  database:
+    url: {db}
+listen_address: 127.0.0.1:0
+""")
+    # pre-create schema + one task
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "JANUS_DATASTORE_KEYS": key}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "janus_tpu.binaries", "aggregator",
+         "--config-file", str(cfg)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO, env=env)
+    try:
+        line = proc.stdout.readline().decode()
+        assert "listening on" in line, (line, proc.stderr.read(200))
+        address = line.strip().rsplit(" ", 1)[-1]
+        # server answers (404 problem doc on unknown route)
+        r = requests.get(f"{address}/nonexistent", timeout=10)
+        assert r.status_code == 404
+        # hpke_config for an unknown task is a DAP problem, not a crash
+        r = requests.get(f"{address}/hpke_config?task_id={_b64(bytes(32))}",
+                         timeout=10)
+        assert r.status_code == 400
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

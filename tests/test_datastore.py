@@ -347,3 +347,37 @@ def test_concurrent_lease_acquisition(ds, task_pair):
         t.join()
     ids = [bytes(lease.leased.aggregation_job_id) for lease in results]
     assert len(ids) == 8 and len(set(ids)) == 8
+
+
+def test_schema_migration_v1_to_v2(tmp_path):
+    """A v1 on-disk datastore upgrades in place via Datastore.migrate()."""
+    import sqlite3
+
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
+    from janus_tpu.datastore.schema import MIGRATIONS, SCHEMA_VERSION, TABLES
+
+    path = str(tmp_path / "v1.db")
+    # Build a v1 database: current DDL minus the v2 migration's column.
+    conn = sqlite3.connect(path)
+    with conn:
+        for ddl in TABLES:
+            ddl_v1 = ddl.replace(
+                "taskprov INTEGER NOT NULL DEFAULT 0,\n", "")
+            conn.execute(ddl_v1)
+        conn.execute("INSERT INTO schema_version (version) VALUES (1)")
+    conn.close()
+
+    ds = Datastore(SqliteBackend(path), Crypter.generate(), MockClock())
+    try:
+        ds.check_schema_version()
+        raise AssertionError("v1 schema must not pass the version check")
+    except Exception:
+        pass
+    ds.migrate()
+    ds.check_schema_version()
+    # the migrated column exists and defaults to 0
+    conn = sqlite3.connect(path)
+    assert conn.execute("SELECT COUNT(*) FROM tasks WHERE taskprov = 0").fetchone()[0] == 0
+    conn.close()
+    assert 2 in MIGRATIONS and SCHEMA_VERSION == 2

@@ -1,0 +1,39 @@
+"""Metrics registry + health/metrics listener (SURVEY.md §5.5)."""
+
+import requests
+
+from janus_tpu.health import HealthServer
+from janus_tpu.metrics import REGISTRY, Registry
+
+
+def test_counter_and_histogram_exposition():
+    reg = Registry()
+    c = reg.counter("test_events", "events")
+    c.add(1, kind="a")
+    c.add(2, kind="a")
+    c.add(5, kind="b")
+    assert c.value(kind="a") == 3
+    h = reg.histogram("test_latency_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(2.0)
+    assert h.count() == 3
+    text = reg.exposition()
+    assert 'test_events{kind="a"} 3' in text
+    assert 'test_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_latency_seconds_count 3" in text
+
+
+def test_health_server_serves_metrics():
+    REGISTRY.counter("test_health_hits", "x").add(1)
+    server = HealthServer().start()
+    try:
+        r = requests.get(f"{server.address}/healthz", timeout=5)
+        assert r.status_code == 200 and r.text == "ok"
+        r = requests.get(f"{server.address}/metrics", timeout=5)
+        assert r.status_code == 200
+        assert "test_health_hits 1" in r.text
+        assert requests.get(f"{server.address}/nope", timeout=5).status_code == 404
+    finally:
+        server.stop()

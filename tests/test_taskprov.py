@@ -1,0 +1,207 @@
+"""Taskprov: wire round-trips, HKDF verify-key derivation, and in-band
+helper opt-in over HTTP (draft-wang-ppm-dap-taskprov; reference
+messages/src/taskprov.rs, aggregator_core/src/taskprov.rs:90,238,
+aggregator.rs:709)."""
+
+import base64
+import hashlib
+
+import requests
+
+from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.core import hpke
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import ephemeral_datastore
+from janus_tpu.messages import (
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    Duration,
+    Extension,
+    ExtensionType,
+    InputShareAad,
+    PartialBatchSelector,
+    PlaintextInputShare,
+    PrepareInit,
+    PrepareStepResult,
+    ReportShare,
+    Role,
+    TIME_INTERVAL,
+    Time,
+)
+from janus_tpu.messages.taskprov import (
+    TASKPROV_HEADER,
+    DpConfig,
+    QueryConfig,
+    TaskConfig,
+    TaskprovQuery,
+    Url,
+    VdafConfig,
+    VdafType,
+)
+from janus_tpu.models import VdafInstance
+from janus_tpu.taskprov import PeerAggregator, random_verify_key_init
+from janus_tpu.vdaf import ping_pong
+
+
+def _task_config(leader_url: str, helper_url: str) -> TaskConfig:
+    return TaskConfig(
+        task_info=b"test-task-info",
+        leader_aggregator_endpoint=Url(leader_url.encode()),
+        helper_aggregator_endpoint=Url(helper_url.encode()),
+        query_config=QueryConfig(
+            time_precision=Duration(3600),
+            max_batch_query_count=1,
+            min_batch_size=1,
+            query=TaskprovQuery(TaskprovQuery.TIME_INTERVAL),
+        ),
+        task_expiration=Time(2_000_000_000),
+        vdaf_config=VdafConfig(DpConfig.none(), VdafType(VdafType.PRIO3_COUNT)),
+    )
+
+
+def test_task_config_roundtrip():
+    tc = _task_config("https://leader.example.com/", "https://helper.example.com/")
+    assert TaskConfig.decode(tc.encode()) == tc
+    assert bytes(tc.task_id()) == hashlib.sha256(tc.encode()).digest()
+
+    fs = TaskConfig(
+        task_info=b"x",
+        leader_aggregator_endpoint=Url(b"https://l/"),
+        helper_aggregator_endpoint=Url(b"https://h/"),
+        query_config=QueryConfig(Duration(300), 2, 100,
+                                 TaskprovQuery(TaskprovQuery.FIXED_SIZE, 500)),
+        task_expiration=Time(1_900_000_000),
+        vdaf_config=VdafConfig(DpConfig.none(),
+                               VdafType(VdafType.PRIO3_SUM_VEC, bits=1,
+                                        length=1000, chunk_length=32)),
+    )
+    assert TaskConfig.decode(fs.encode()) == fs
+    inst = fs.vdaf_config.vdaf_type.to_vdaf_instance()
+    assert inst == VdafInstance.prio3_sum_vec(1, 1000, 32)
+
+
+def test_verify_key_derivation_deterministic():
+    vki = bytes(range(32))
+    peer = PeerAggregator(
+        endpoint="https://leader.example.com/", role=Role.LEADER,
+        verify_key_init=vki,
+        collector_hpke_config=HpkeKeypair.generate(9).config,
+        report_expiry_age=None, tolerable_clock_skew=Duration(60),
+        aggregator_auth_tokens=(AuthenticationToken.bearer("tok"),),
+    )
+    tc = _task_config("https://leader.example.com/", "https://helper.example.com/")
+    task_id = tc.task_id()
+    inst = VdafInstance.prio3_count()
+    k1 = peer.derive_vdaf_verify_key(task_id, inst)
+    k2 = peer.derive_vdaf_verify_key(task_id, inst)
+    assert k1 == k2 and len(k1) == inst.verify_key_length
+    # distinct task ids diverge
+    other = _task_config("https://leader.example.com/", "https://other.example.com/")
+    assert peer.derive_vdaf_verify_key(other.task_id(), inst) != k1
+
+
+def test_taskprov_opt_in_over_http():
+    clock = MockClock(Time(1_600_000_000))
+    ds = ephemeral_datastore(clock)
+    agg = Aggregator(ds, clock, AggregatorConfig(taskprov_enabled=True))
+    server = DapHttpServer(agg).start()
+    try:
+        # Provision global HPKE key + the leader peer.
+        global_kp = HpkeKeypair.generate(33)
+        ds.run_tx("g", lambda tx: tx.put_global_hpke_keypair(global_kp))
+        ds.run_tx("g", lambda tx: tx.set_global_hpke_keypair_state(
+            33, m.HpkeKeyState.ACTIVE))
+        auth_token = AuthenticationToken.random_bearer()
+        collector_kp = HpkeKeypair.generate(9)
+        leader_url = "https://leader.example.com/"
+        peer = PeerAggregator(
+            endpoint=leader_url, role=Role.LEADER,
+            verify_key_init=random_verify_key_init(),
+            collector_hpke_config=collector_kp.config,
+            report_expiry_age=None,
+            tolerable_clock_skew=Duration(60),
+            aggregator_auth_tokens=(auth_token,),
+        )
+        ds.run_tx("p", lambda tx: tx.put_taskprov_peer_aggregator(peer))
+
+        tc = _task_config(leader_url, server.address)
+        task_id = tc.task_id()
+        header = base64.urlsafe_b64encode(tc.encode()).rstrip(b"=").decode()
+
+        # Leader-side oracle: derive the same verify key, shard reports to
+        # the GLOBAL helper HPKE key with the taskprov extension.
+        inst = tc.vdaf_config.vdaf_type.to_vdaf_instance()
+        verify_key = peer.derive_vdaf_verify_key(task_id, inst)
+        from janus_tpu.models.vdaf_instance import vdaf_for_instance
+
+        vdaf = vdaf_for_instance(inst)
+        tp_ext = Extension(ExtensionType.TASKPROV, b"")
+        import os
+
+        prepare_inits, states = [], []
+        for meas in [1, 1, 0]:
+            rid = os.urandom(16)
+            from janus_tpu.messages import ReportId, ReportMetadata
+
+            metadata = ReportMetadata(ReportId(rid), clock.now())
+            rand = os.urandom(vdaf.RAND_SIZE)
+            pub, shares = vdaf.shard(meas, rid, rand)
+            encoded_pub = vdaf.encode_public_share(pub)
+            aad = InputShareAad(task_id, metadata, encoded_pub).encode()
+            helper_pt = PlaintextInputShare(
+                (tp_ext,), vdaf.encode_input_share(1, shares[1])).encode()
+            enc = hpke.seal(
+                global_kp.config,
+                hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT,
+                                      Role.HELPER),
+                helper_pt, aad)
+            st, msg = ping_pong.leader_initialized(
+                vdaf, verify_key, rid, pub, shares[0])
+            rs = ReportShare(metadata, encoded_pub, enc)
+            prepare_inits.append(PrepareInit(rs, msg.encode()))
+            states.append(st)
+
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector(TIME_INTERVAL),
+            prepare_inits=tuple(prepare_inits),
+        )
+        job_id = AggregationJobId.random()
+        url = f"{server.address}/tasks/{task_id}/aggregation_jobs/{job_id}"
+        sess = requests.Session()
+
+        # Without the taskprov header the task is unknown.
+        r = sess.put(url, data=req.encode(), headers=auth_token.request_headers())
+        assert r.status_code == 400
+
+        # With the header: opt-in + aggregation succeed.
+        headers = {**auth_token.request_headers(), TASKPROV_HEADER: header}
+        r = sess.put(url, data=req.encode(), headers=headers)
+        assert r.status_code == 200, r.content
+        resp = AggregationJobResp.decode(r.content)
+        agg_share = vdaf.aggregate_init()
+        for pr, st in zip(resp.prepare_resps, states):
+            assert pr.result.kind == PrepareStepResult.CONTINUE, pr
+            fin = ping_pong.leader_continued(
+                vdaf, st, ping_pong.PingPongMessage.decode(pr.result.message))
+            agg_share = vdaf.aggregate_update(agg_share, fin.out_share)
+
+        # The opted-in task exists, is marked taskprov, and has the derived key.
+        task = ds.run_tx("t", lambda tx: tx.get_aggregator_task(task_id))
+        assert task is not None and task.taskprov
+        assert task.vdaf_verify_key == verify_key
+
+        # Wrong auth token is rejected even with the header.
+        bad = AuthenticationToken.random_bearer()
+        r = sess.put(
+            f"{server.address}/tasks/{task_id}/aggregation_jobs/{AggregationJobId.random()}",
+            data=req.encode(),
+            headers={**bad.request_headers(), TASKPROV_HEADER: header})
+        assert r.status_code == 403
+    finally:
+        server.stop()
