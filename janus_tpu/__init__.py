@@ -13,12 +13,22 @@ A from-scratch re-design of the capabilities of the reference implementation
   ``VdafInstance`` / ``vdaf_dispatch!``, reference core/src/vdaf.rs:65,517).
 - ``janus_tpu.parallel`` — device mesh / sharding of the report axis,
   aggregate-share collectives.
-- ``janus_tpu.messages`` — DAP TLS-syntax wire format (reference messages/).
-- ``janus_tpu.core``     — HPKE, clocks, auth tokens, retries (reference core/).
+- ``janus_tpu.messages`` — DAP + taskprov TLS-syntax wire format
+  (reference messages/).
+- ``janus_tpu.core``     — HPKE, clocks, auth tokens, retries, DP seam
+  (reference core/).
 - ``janus_tpu.datastore``— transactional state layer ("the database is the
   checkpoint", reference aggregator_core/).
-- ``janus_tpu.aggregator`` — protocol engine, HTTP handlers, daemons
-  (reference aggregator/).
+- ``janus_tpu.aggregator`` — protocol engine, HTTP surface, job drivers,
+  creator, writers, GC (reference aggregator/).
+- ``janus_tpu.aggregator_api`` — operator REST API (reference aggregator_api/).
+- ``janus_tpu.engine``   — the batched prepare engine behind the dispatch seam.
+- ``janus_tpu.taskprov`` — peer aggregators + verify-key derivation.
+- ``janus_tpu.client`` / ``janus_tpu.collector`` — DAP client/collector SDKs.
+- ``janus_tpu.interop``  — draft-dcook interop test servers.
+- ``janus_tpu.binaries`` / ``janus_tpu.tools`` / ``janus_tpu.config`` —
+  service binaries, operator CLI, YAML config.
+- ``janus_tpu.metrics`` / ``janus_tpu.health`` — observability.
 """
 
 __version__ = "0.1.0"

@@ -174,7 +174,8 @@ class AggregationJobDriver:
             if rep.status == "finished":
                 writables.append(WritableReportAggregation(
                     ra.with_state(m.ReportAggregationState.finished()),
-                    rep.out_share_raw))
+                    rep.out_share_raw, device_shares=rep.device_shares,
+                    lane=rep.lane))
             elif rep.status == "continued":
                 # multi-round: persist the transition for the next step
                 writables.append(WritableReportAggregation(

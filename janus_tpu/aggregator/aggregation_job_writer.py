@@ -27,10 +27,16 @@ from janus_tpu.messages import Interval, PrepareError, ReportIdChecksum
 
 @dataclass
 class WritableReportAggregation:
-    """A report aggregation plus (if it finished) its raw output share."""
+    """A report aggregation plus (if it finished) its raw output share.
+
+    `device_shares`/`lane` (when set) reference the engine's resident
+    on-device batch array so accumulation can mask-reduce in HBM instead of
+    transferring per-report shares (see BatchPrio3.aggregate_masked)."""
 
     report_aggregation: m.ReportAggregation
-    output_share_raw: object | None = None  # np.ndarray, engine raw form
+    output_share_raw: object | None = None  # engine raw form (np or jax)
+    device_shares: object | None = None
+    lane: int | None = None
 
     def with_failure(self, error: PrepareError) -> "WritableReportAggregation":
         from janus_tpu.messages import PrepareResp, PrepareStepResult
@@ -132,21 +138,20 @@ class AggregationJobWriter:
         for key in sorted(by_batch):
             ident = idents[key]
             group = by_batch[key]
-            rows = [w.output_share_raw for w in group
-                    if w.output_share_raw is not None
-                    and w.report_aggregation.state.kind
-                    is m.ReportAggregationStateKind.FINISHED]
-            count = len(rows)
+            finished = [
+                w for w in group
+                if w.output_share_raw is not None
+                and w.report_aggregation.state.kind
+                is m.ReportAggregationStateKind.FINISHED
+            ]
+            count = len(finished)
             checksum = ReportIdChecksum.zero()
             times = []
-            for w in group:
-                ra = w.report_aggregation
-                if (w.output_share_raw is not None and ra.state.kind
-                        is m.ReportAggregationStateKind.FINISHED):
-                    checksum = checksum.updated_with(ra.report_id)
-                    times.append(ra.time)
-            if rows:
-                delta_share = self.engine.aggregate_raw_rows(rows)
+            for w in finished:
+                checksum = checksum.updated_with(w.report_aggregation.report_id)
+                times.append(w.report_aggregation.time)
+            if finished:
+                delta_share = self._aggregate_group(finished)
                 interval = batch_interval_spanning(times)
             else:
                 delta_share = None
@@ -162,6 +167,23 @@ class AggregationJobWriter:
             )
 
         return final
+
+    def _aggregate_group(self, finished: list[WritableReportAggregation]):
+        """Sum a batch group's output shares.  When every row lives in the
+        engine's resident device array, mask-reduce it in HBM (one small
+        transfer per batch); otherwise fall back to row stacking."""
+        import numpy as np
+
+        first = finished[0].device_shares
+        if (first is not None
+                and all(w.device_shares is first and w.lane is not None
+                        for w in finished)):
+            mask = np.zeros(first.shape[0], dtype=bool)
+            for w in finished:
+                mask[w.lane] = True
+            return self.engine.aggregate_masked(first, mask)
+        return self.engine.aggregate_raw_rows(
+            [w.output_share_raw for w in finished])
 
     def _accumulate_shard(self, tx, vdaf, ident, agg_param: bytes, ord_: int,
                           delta_share, count: int, interval: Interval,

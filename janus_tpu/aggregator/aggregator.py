@@ -465,6 +465,7 @@ class Aggregator:
             rs = pi.report_share
             rid = rs.metadata.report_id
             out_share = None
+            dev = lane = None
             if i in lane_error:
                 state = m.ReportAggregationState.failed(lane_error[i])
                 result = PrepareStepResult.rejected(lane_error[i])
@@ -474,6 +475,7 @@ class Aggregator:
                     state = m.ReportAggregationState.finished()
                     result = PrepareStepResult.continued(rep.outbound.encode())
                     out_share = rep.out_share_raw
+                    dev, lane = rep.device_shares, rep.lane
                 elif rep.status == "continued":
                     # multi-round VDAF: helper waits for the leader
                     state = m.ReportAggregationState.waiting_helper(
@@ -488,7 +490,9 @@ class Aggregator:
                 time=rs.metadata.time, ord=i, state=state,
                 last_prep_resp=PrepareResp(rid, result),
             )
-            writables.append(WritableReportAggregation(ra, out_share))
+            writables.append(WritableReportAggregation(ra, out_share,
+                                                       device_shares=dev,
+                                                       lane=lane))
 
         times = [pi.report_share.metadata.time for pi in req.prepare_inits]
         job = m.AggregationJob(

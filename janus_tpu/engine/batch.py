@@ -15,9 +15,10 @@ recomputed with the host oracle.  Per-report proof failures are NOT
 fallbacks: they surface as `status="failed"` lanes, matching the reference's
 per-report PrepareError semantics (aggregator.rs:1969-1993).
 
-Only the standard TurboShake128 XOF runs on device; the HmacSha256Aes128
-multiproof variant (core/src/vdaf.rs:24) currently takes the host path for
-XOFs and the device path is disabled for it (engine falls back per batch).
+Both XOF families run on device: TurboShake128 as batched Keccak sponges
+(janus_tpu.ops.keccak / xof_batch) and the HmacSha256Aes128 multiproof
+variant (core/src/vdaf.rs:24) as batched HMAC-SHA256 + AES-128-CTR kernels
+(janus_tpu.ops.hmac_aes).
 """
 
 from __future__ import annotations
@@ -43,19 +44,65 @@ from janus_tpu.vdaf.prio3 import (
     Prio3,
     VdafError,
 )
-from janus_tpu.vdaf.xof import XofTurboShake128
+from janus_tpu.vdaf.xof import XofHmacSha256Aes128, XofTurboShake128
+
+
+class _TurboXofOps:
+    """Device XofTurboShake128: seed is absorbed into the sponge message."""
+
+    def __init__(self, field):
+        self.expand_raw = (xof_batch.expand_field64 if field is Field64
+                           else xof_batch.expand_field128)
+
+    def derive_seed(self, bs, seed, dst, binder_parts, seed_size=16):
+        return xof_batch.derive_seed(
+            bs, [xof_batch.xof_prefix(dst), seed] + list(binder_parts),
+            seed_size)
+
+    def expand(self, bs, seed, dst, binder_parts, n):
+        return self.expand_raw(
+            bs, [xof_batch.xof_prefix(dst), seed] + list(binder_parts), n)
+
+
+class _HmacXofOps:
+    """Device XofHmacSha256Aes128: seed is the HMAC key; the message is
+    len(dst) || dst || binder (janus_tpu.ops.hmac_aes)."""
+
+    def __init__(self, field):
+        from janus_tpu.ops import hmac_aes
+
+        assert field is Field64, "multiproof XOF is defined over Field64"
+        self._m = hmac_aes
+
+    def derive_seed(self, bs, seed, dst, binder_parts, seed_size=32):
+        return self._m.derive_seed(
+            bs, seed, [xof_batch.xof_prefix(dst)] + list(binder_parts),
+            seed_size)
+
+    def expand(self, bs, seed, dst, binder_parts, n):
+        return self._m.expand_field64(
+            bs, seed, [xof_batch.xof_prefix(dst)] + list(binder_parts), n)
 
 
 @dataclass
 class PreparedReport:
-    """Per-report outcome of a batched prepare step."""
+    """Per-report outcome of a batched prepare step.
+
+    `out_share_raw` may be a LAZY on-device slice (jax array): output shares
+    stay in HBM end-to-end and only per-batch aggregates cross the
+    host<->device boundary (`device_shares`/`lane` let the aggregation path
+    mask-reduce the whole batch without per-lane transfers).  np.asarray()
+    materializes a single lane when host code genuinely needs it.
+    """
 
     status: str  # "finished" | "continued" | "failed"
     error: str | None = None
     outbound: ping_pong.PingPongMessage | None = None
-    out_share_raw: np.ndarray | None = None  # [OUTPUT_LEN, L] uint32, raw form
+    out_share_raw: object | None = None  # [OUTPUT_LEN, L] uint32 (np or jax)
     prep_share: bytes | None = None
     state: object | None = None  # leader: PingPongContinued
+    device_shares: object | None = None  # jax [M, OUTPUT_LEN, L], whole batch
+    lane: int | None = None
 
 
 def _bytes_rows(rows: list[bytes], width: int) -> np.ndarray:
@@ -93,11 +140,11 @@ class BatchPrio3:
         self.L = self.f.LIMBS
         self.P = vdaf.proofs
         self.has_jr = vdaf.has_joint_rand
-        # Only the TurboShake128 XOF has a device implementation.
-        self.device_ok = vdaf.xof is XofTurboShake128
-        self._expand = (
-            xof_batch.expand_field64 if self.field is Field64 else xof_batch.expand_field128
-        )
+        # Both standard XOF families have device implementations.
+        self.device_ok = vdaf.xof in (XofTurboShake128, XofHmacSha256Aes128)
+        self.xops = (_HmacXofOps(self.field)
+                     if vdaf.xof is XofHmacSha256Aes128
+                     else _TurboXofOps(self.field))
         # Optional report-axis mesh (janus_tpu.parallel): kernels become SPMD
         # programs sharded on their leading axis; batch buckets round up to a
         # multiple of the device count.
@@ -195,17 +242,15 @@ class BatchPrio3:
         f = self.f
         N = bs[0]
         P = self.P
+        ss = self.vdaf.SEED_SIZE
         reject = jnp.zeros(bs, dtype=bool)
         if self.has_jr:
             state_seed_parts = parts_static  # list of u8 arrays in order
-            state_seed = xof_batch.derive_seed(
-                bs,
-                [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_SEED),
-                                      bytes(self.vdaf.SEED_SIZE))] + state_seed_parts,
-            )
-            jr_raw, rej = self._expand(
-                bs,
-                [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RANDOMNESS)), state_seed],
+            state_seed = self.xops.derive_seed(
+                bs, bytes(ss), self._dst(USAGE_JOINT_RAND_SEED),
+                state_seed_parts, ss)
+            jr_raw, rej = self.xops.expand(
+                bs, state_seed, self._dst(USAGE_JOINT_RANDOMNESS), [],
                 P * self.flp.JOINT_RAND_LEN,
             )
             reject = reject | rej
@@ -213,10 +258,9 @@ class BatchPrio3:
         else:
             state_seed = None
             jr = f.zeros(bs + (P, 0))
-        qr_raw, rej = self._expand(
-            bs,
-            [xof_batch.xof_prefix(self._dst(USAGE_QUERY_RANDOMNESS)),
-             jnp.broadcast_to(vk, bs + (self.vdaf.VERIFY_KEY_SIZE,)), nonces],
+        qr_raw, rej = self.xops.expand(
+            bs, jnp.broadcast_to(vk, bs + (self.vdaf.VERIFY_KEY_SIZE,)),
+            self._dst(USAGE_QUERY_RANDOMNESS), [nonces],
             P * self.flp.QUERY_RAND_LEN,
         )
         reject = reject | rej
@@ -240,27 +284,24 @@ class BatchPrio3:
 
         def kernel(vk, seeds, blinds, nonces, pub0, leader_jr_parts, leader_verifs_raw):
             bs = (N,)
-            meas_raw, rej1 = self._expand(
-                bs,
-                [xof_batch.xof_prefix(self._dst(USAGE_MEAS_SHARE)), seeds, b"\x01"],
+            ss = self.vdaf.SEED_SIZE
+            meas_raw, rej1 = self.xops.expand(
+                bs, seeds, self._dst(USAGE_MEAS_SHARE), [b"\x01"],
                 self.flp.MEAS_LEN,
             )
-            proofs_raw, rej2 = self._expand(
-                bs,
-                [xof_batch.xof_prefix(self._dst(USAGE_PROOF_SHARE)), seeds, b"\x01"],
+            proofs_raw, rej2 = self.xops.expand(
+                bs, seeds, self._dst(USAGE_PROOF_SHARE), [b"\x01"],
                 P * self.flp.PROOF_LEN,
             )
             reject = rej1 | rej2
             if self.has_jr:
                 meas_bytes = xof_batch.vec_limbs_to_bytes(meas_raw)
-                own_part = xof_batch.derive_seed(
-                    bs,
-                    [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_PART)), blinds,
-                     b"\x01", nonces, meas_bytes],
-                )
+                own_part = self.xops.derive_seed(
+                    bs, blinds, self._dst(USAGE_JOINT_RAND_PART),
+                    [b"\x01", nonces, meas_bytes], ss)
                 parts = [pub0, own_part]
             else:
-                own_part = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+                own_part = jnp.zeros(bs + (ss,), dtype=jnp.uint8)
                 parts = []
             verifier, state_seed, rej3, bad_t, meas = self._kernel_common(
                 bs, meas_raw, proofs_raw, nonces, vk, parts
@@ -271,20 +312,17 @@ class BatchPrio3:
             total = f.add(verifier, lv)
             proof_ok = jnp.all(self.bflp.decide(total), axis=-1)
             if self.has_jr:
-                msg_seed = xof_batch.derive_seed(
-                    bs,
-                    [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_SEED),
-                                          bytes(self.vdaf.SEED_SIZE)),
-                     leader_jr_parts, own_part],
-                )
+                msg_seed = self.xops.derive_seed(
+                    bs, bytes(ss), self._dst(USAGE_JOINT_RAND_SEED),
+                    [leader_jr_parts, own_part], ss)
                 jr_ok = jnp.all(msg_seed == state_seed, axis=-1)
             else:
-                msg_seed = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+                msg_seed = jnp.zeros(bs + (ss,), dtype=jnp.uint8)
                 jr_ok = jnp.ones(bs, dtype=bool)
             out_share = f.to_raw(self.bflp.truncate(meas))
-            verif_raw = f.to_raw(verifier).reshape(bs + (P * vlen, self.L))
-            return (verif_raw, own_part, msg_seed, out_share, proof_ok, jr_ok,
-                    reject | bad_t)
+            # The 1-round helper sends only the finish seed on the wire, so
+            # neither its verifier nor its joint-rand part leaves the device.
+            return (msg_seed, out_share, proof_ok, jr_ok, reject | bad_t)
 
         fn = self._jit(kernel, 6)
         self._helper_fns[N] = fn
@@ -299,16 +337,15 @@ class BatchPrio3:
 
         def kernel(vk, meas_raw, proofs_raw, blinds, nonces, pub1):
             bs = (N,)
+            ss = self.vdaf.SEED_SIZE
             if self.has_jr:
                 meas_bytes = xof_batch.vec_limbs_to_bytes(meas_raw)
-                own_part = xof_batch.derive_seed(
-                    bs,
-                    [xof_batch.xof_prefix(self._dst(USAGE_JOINT_RAND_PART)), blinds,
-                     b"\x00", nonces, meas_bytes],
-                )
+                own_part = self.xops.derive_seed(
+                    bs, blinds, self._dst(USAGE_JOINT_RAND_PART),
+                    [b"\x00", nonces, meas_bytes], ss)
                 parts = [own_part, pub1]
             else:
-                own_part = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+                own_part = jnp.zeros(bs + (ss,), dtype=jnp.uint8)
                 parts = []
             verifier, state_seed, reject, bad_t, meas = self._kernel_common(
                 bs, meas_raw, proofs_raw, nonces, vk, parts
@@ -316,7 +353,7 @@ class BatchPrio3:
             out_share = f.to_raw(self.bflp.truncate(meas))
             verif_raw = f.to_raw(verifier).reshape(bs + (P * vlen, self.L))
             if state_seed is None:
-                state_seed = jnp.zeros(bs + (16,), dtype=jnp.uint8)
+                state_seed = jnp.zeros(bs + (ss,), dtype=jnp.uint8)
             return verif_raw, own_part, state_seed, out_share, reject | bad_t
 
         fn = self._jit(kernel, 5)
@@ -405,10 +442,18 @@ class BatchPrio3:
         from janus_tpu.metrics import device_batch_reports, device_batch_seconds
 
         t0 = _t.monotonic()
-        verif_raw, own_part, msg_seed, out_share, proof_ok, jr_ok, fallback = (
-            np.asarray(x) for x in fn(vk, seeds, blinds, nonce_rows, pub0,
-                                      ljr, lverif)
-        )
+        # Only the small per-lane outputs come back to the host; the output
+        # shares ([M, OUTPUT_LEN, L] — by far the largest tensor) and the
+        # helper verifier stay on device.  Downstream aggregation reduces
+        # out_share_d with a lane mask and transfers one [OUTPUT_LEN, L] sum
+        # per batch (HBM-bandwidth discipline; the 1-round helper never
+        # sends its verifier on the wire, only the finish seed).
+        (msg_seed_d, out_share_d, proof_ok_d, jr_ok_d,
+         fallback_d) = fn(vk, seeds, blinds, nonce_rows, pub0, ljr, lverif)
+        msg_seed = np.asarray(msg_seed_d)
+        proof_ok = np.asarray(proof_ok_d)
+        jr_ok = np.asarray(jr_ok_d)
+        fallback = np.asarray(fallback_d)
         device_batch_seconds.observe(_t.monotonic() - t0, kind="helper_init",
                                      bucket=M)
         device_batch_reports.add(N, kind="helper_init")
@@ -432,12 +477,9 @@ class BatchPrio3:
             outbound = ping_pong.PingPongMessage(
                 ping_pong.PingPongMessage.TYPE_FINISH, prep_msg=prep_msg
             )
-            prep_share = (bytes(own_part[i]) if self.has_jr else b"") + (
-                verif_raw[i].astype("<u4").tobytes()
-            )
             out.append(PreparedReport(
-                "finished", outbound=outbound, out_share_raw=out_share[i],
-                prep_share=prep_share,
+                "finished", outbound=outbound, out_share_raw=out_share_d[i],
+                device_shares=out_share_d, lane=i,
             ))
         return out
 
@@ -507,10 +549,14 @@ class BatchPrio3:
         fn = self._leader_fn(M)
         nonce_rows = np.zeros((M, 16), dtype=np.uint8)
         nonce_rows[:N] = nonces_arr(nonces)
-        verif_raw, own_part, state_seed, out_share, fallback = (
-            np.asarray(x)
-            for x in fn(vk, meas_raw, proofs_raw, blinds, nonce_rows, pub1)
-        )
+        # The leader's verifier IS wire payload (PrepareInit prep share), so
+        # it must come to the host; output shares stay on device.
+        verif_raw_d, own_part_d, state_seed_d, out_share_d, fallback_d = fn(
+            vk, meas_raw, proofs_raw, blinds, nonce_rows, pub1)
+        verif_raw = np.asarray(verif_raw_d)
+        own_part = np.asarray(own_part_d)
+        state_seed = np.asarray(state_seed_d)
+        fallback = np.asarray(fallback_d)
         out: list[PreparedReport] = []
         for i in range(N):
             if i in decode_err:
@@ -528,13 +574,14 @@ class BatchPrio3:
             # PrepState.out_share carries raw limbs here (not Python ints):
             # prep_next passes it through untouched, and both leader_finish
             # and aggregate() consume the raw form directly.
-            state = ping_pong.PingPongContinued(PrepState(out_share[i], jr_seed), 0)
+            state = ping_pong.PingPongContinued(PrepState(out_share_d[i], jr_seed), 0)
             outbound = ping_pong.PingPongMessage(
                 ping_pong.PingPongMessage.TYPE_INITIALIZE, prep_share=prep_share
             )
             out.append(PreparedReport(
-                "continued", outbound=outbound, out_share_raw=out_share[i],
+                "continued", outbound=outbound, out_share_raw=out_share_d[i],
                 prep_share=prep_share, state=state,
+                device_shares=out_share_d, lane=i,
             ))
         return out
 
@@ -584,9 +631,11 @@ class BatchPrio3:
                 continue
             try:
                 finished = ping_pong.leader_continued(self.vdaf, rep.state, msg)
-                o = finished.out_share  # raw limbs (device path) or ints (host)
-                raw = o if isinstance(o, np.ndarray) else self._ints_to_raw(o)
-                out.append(PreparedReport("finished", out_share_raw=raw))
+                o = finished.out_share  # raw limbs (np/device) or ints (host)
+                raw = o if not isinstance(o, list) else self._ints_to_raw(o)
+                out.append(PreparedReport(
+                    "finished", out_share_raw=raw,
+                    device_shares=rep.device_shares, lane=rep.lane))
             except (VdafError, NotImplementedError) as e:
                 out.append(PreparedReport("failed", error=str(e)))
         return out
@@ -606,21 +655,27 @@ class BatchPrio3:
         ]
         return self.aggregate_raw_rows(rows)
 
-    def aggregate_raw_rows(self, rows: list[np.ndarray]) -> list[int]:
+    def aggregate_raw_rows(self, rows: list) -> list[int]:
         """Device tree-sum of raw output-share rows -> aggregate share ints."""
         if not rows:
             return self.vdaf.aggregate_init()
         K = len(rows)
         M = self._bucket(K)
         arr = np.zeros((M,) + tuple(rows[0].shape), dtype=np.uint32)
-        arr[:K] = np.stack(rows)
+        arr[:K] = np.stack([np.asarray(r) for r in rows])
         mask = np.zeros(M, dtype=bool)
         mask[:K] = True
+        return self.aggregate_masked(arr, mask)
+
+    def aggregate_masked(self, shares, mask) -> list[int]:
+        """Masked modular sum over the report axis, entirely on device:
+        `shares` may be the engine's resident [M, OUTPUT_LEN, L] batch array,
+        so only the [OUTPUT_LEN, L] result crosses to the host."""
         if self._agg_fn is None:
             from janus_tpu.parallel import aggregate_fn
 
             self._agg_fn = aggregate_fn(self.f, self.mesh)
-        return self._raw_to_ints(np.asarray(self._agg_fn(arr, mask)))
+        return self._raw_to_ints(np.asarray(self._agg_fn(shares, np.asarray(mask))))
 
     # -- limb conversion helpers ------------------------------------------
 

@@ -1,0 +1,339 @@
+"""Interop/conformance test servers per draft-dcook-ppm-dap-interop-test-design
+(reference interop_binaries/: janus_interop_client, janus_interop_aggregator,
+janus_interop_collector).
+
+Each server exposes the /internal/test/* JSON API used by cross-implementation
+test runners; the aggregator variant additionally serves DAP on the same
+port.  Numbers in VDAF JSON objects may arrive as strings (the reference's
+NumberAsString convention) — parsing is tolerant of both.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from janus_tpu.core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.messages import (
+    BatchId,
+    Duration,
+    FixedSizeQuery,
+    HpkeConfig,
+    Interval,
+    Query,
+    Role,
+    TaskId,
+    Time,
+)
+from janus_tpu.models import VdafInstance
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _num(v) -> int:
+    return int(v)
+
+
+def vdaf_from_json(obj: dict) -> VdafInstance:
+    """VdafObject JSON (reference interop_binaries/src/lib.rs:109) ->
+    VdafInstance."""
+    kind = obj["type"]
+    if kind == "Prio3Count":
+        return VdafInstance.prio3_count()
+    if kind == "Prio3Sum":
+        return VdafInstance.prio3_sum(_num(obj["bits"]))
+    if kind == "Prio3SumVec":
+        return VdafInstance.prio3_sum_vec(
+            _num(obj["bits"]), _num(obj["length"]), _num(obj["chunk_length"]))
+    if kind == "Prio3SumVecField64MultiproofHmacSha256Aes128":
+        return VdafInstance.prio3_sum_vec_field64_multiproof_hmac_sha256_aes128(
+            _num(obj["proofs"]), _num(obj["bits"]), _num(obj["length"]),
+            _num(obj["chunk_length"]))
+    if kind == "Prio3Histogram":
+        return VdafInstance.prio3_histogram(
+            _num(obj["length"]), _num(obj["chunk_length"]))
+    if kind == "Prio3FixedPointBoundedL2VecSum":
+        bitsize = _num(obj.get("bitsize", 16))
+        length = _num(obj["length"])
+        chunk = _num(obj.get("chunk_length",
+                             max(1, round((length * bitsize) ** 0.5))))
+        return VdafInstance.prio3_fixedpoint_boundedl2_vec_sum(
+            bitsize, length, chunk)
+    raise ValueError(f"unsupported VDAF {kind}")
+
+
+def parse_measurement(vdaf: VdafInstance, measurement):
+    """Interop measurements arrive as strings / lists of strings."""
+    if vdaf.kind in ("Prio3Count", "Prio3Sum", "Prio3Histogram"):
+        return _num(measurement)
+    if vdaf.kind == "Prio3FixedPointBoundedL2VecSum":
+        return [float(x) for x in measurement]
+    return [_num(x) for x in measurement]
+
+
+def format_result(vdaf: VdafInstance, result):
+    if isinstance(result, list):
+        return [str(x) for x in result]
+    return str(result)
+
+
+class _JsonHttpServer:
+    """Tiny JSON-POST server base with /internal/test/ready."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    req = json.loads(body) if body else {}
+                    if path == "/internal/test/ready":
+                        resp = {}
+                    else:
+                        resp = outer.dispatch(path, req)
+                    status = 200
+                except Exception as e:
+                    traceback.print_exc()
+                    resp = {"status": "error", "error": str(e)}
+                    status = 500
+                data = json.dumps(resp).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                outer.handle_get(self)
+
+            def do_PUT(self):
+                outer.handle_other(self, "PUT")
+
+            def do_DELETE(self):
+                outer.handle_other(self, "DELETE")
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    def handle_get(self, handler) -> None:
+        handler.send_response(404)
+        handler.send_header("Content-Length", "0")
+        handler.end_headers()
+
+    def handle_other(self, handler, method: str) -> None:
+        handler.send_response(404)
+        handler.send_header("Content-Length", "0")
+        handler.end_headers()
+
+    def dispatch(self, path: str, req: dict) -> dict:
+        raise KeyError(f"no such endpoint {path}")
+
+    @property
+    def address(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class InteropClient(_JsonHttpServer):
+    """janus_interop_client: uploads measurements on request."""
+
+    def dispatch(self, path: str, req: dict) -> dict:
+        if path != "/internal/test/upload":
+            raise KeyError(path)
+        from janus_tpu.client import Client, ClientParameters
+
+        vdaf = vdaf_from_json(req["vdaf"])
+        measurement = parse_measurement(vdaf, req["measurement"])
+        client = Client(
+            ClientParameters(
+                TaskId.from_str(req["task_id"]),
+                req["leader"], req["helper"],
+                Duration(_num(req["time_precision"]))),
+            vdaf)
+        time = Time(_num(req["time"])) if req.get("time") is not None else None
+        client.upload(measurement, time=time)
+        return {"status": "success"}
+
+
+class InteropAggregator(_JsonHttpServer):
+    """janus_interop_aggregator: DAP server + /internal/test/add_task."""
+
+    def __init__(self, datastore, clock, host: str = "127.0.0.1", port: int = 0,
+                 dap_port: int = 0):
+        super().__init__(host, port)
+        from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+
+        self.datastore = datastore
+        self.aggregator = Aggregator(datastore, clock, AggregatorConfig(
+            max_upload_batch_size=1))
+        self.dap_server = DapHttpServer(self.aggregator, host, dap_port)
+
+    def start(self):
+        self.dap_server.start()
+        return super().start()
+
+    def stop(self) -> None:
+        super().stop()
+        self.dap_server.stop()
+
+    def dispatch(self, path: str, req: dict) -> dict:
+        if path == "/internal/test/endpoint_for_task":
+            return {"status": "success", "endpoint": self.dap_server.address}
+        if path != "/internal/test/add_task":
+            raise KeyError(path)
+        from janus_tpu.datastore.task import AggregatorTask, QueryTypeCfg
+
+        role = Role.LEADER if req["role"] == "leader" else Role.HELPER
+        vdaf = vdaf_from_json(req["vdaf"])
+        if _num(req["query_type"]) == 1:
+            query_cfg = QueryTypeCfg.time_interval()
+        else:
+            mbs = req.get("max_batch_size")
+            query_cfg = QueryTypeCfg.fixed_size(
+                _num(mbs) if mbs is not None else None)
+        leader_token = AuthenticationToken.dap_auth(
+            req["leader_authentication_token"])
+        collector_hash = None
+        if req.get("collector_authentication_token"):
+            collector_hash = AuthenticationTokenHash.of(
+                AuthenticationToken.dap_auth(
+                    req["collector_authentication_token"]))
+        peer = req["helper"] if role is Role.LEADER else req["leader"]
+        task = AggregatorTask(
+            task_id=TaskId.from_str(req["task_id"]),
+            peer_aggregator_endpoint=peer,
+            query_type=query_cfg,
+            vdaf=vdaf,
+            role=role,
+            vdaf_verify_key=_unb64(req["vdaf_verify_key"]),
+            min_batch_size=_num(req["min_batch_size"]),
+            time_precision=Duration(_num(req["time_precision"])),
+            tolerable_clock_skew=Duration(600),
+            task_expiration=(Time(_num(req["task_expiration"]))
+                             if req.get("task_expiration") is not None else None),
+            collector_hpke_config=HpkeConfig.decode(
+                _unb64(req["collector_hpke_config"])),
+            aggregator_auth_token=leader_token if role is Role.LEADER else None,
+            aggregator_auth_token_hash=(AuthenticationTokenHash.of(leader_token)
+                                        if role is Role.HELPER else None),
+            collector_auth_token_hash=collector_hash,
+            hpke_keys=(HpkeKeypair.generate(1),),
+        )
+        self.datastore.run_tx("interop_add_task",
+                              lambda tx: tx.put_aggregator_task(task))
+        self.aggregator.invalidate_task_cache(task.task_id)
+        return {"status": "success"}
+
+
+class InteropCollector(_JsonHttpServer):
+    """janus_interop_collector: add_task + collection start/poll."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self._tasks: dict[bytes, dict] = {}
+        self._handles: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._next_handle = 0
+
+    def dispatch(self, path: str, req: dict) -> dict:
+        if path == "/internal/test/add_task":
+            return self._add_task(req)
+        if path == "/internal/test/collection_start":
+            return self._collection_start(req)
+        if path == "/internal/test/collection_poll":
+            return self._collection_poll(req)
+        raise KeyError(path)
+
+    def _add_task(self, req: dict) -> dict:
+        task_id = TaskId.from_str(req["task_id"])
+        keypair = HpkeKeypair.generate(200)
+        with self._lock:
+            self._tasks[bytes(task_id)] = {
+                "vdaf": vdaf_from_json(req["vdaf"]),
+                "leader": req["leader"],
+                "auth_token": AuthenticationToken.dap_auth(
+                    req["collector_authentication_token"]),
+                "keypair": keypair,
+                "batch_mode": _num(req.get("query_type", 1)),
+            }
+        return {"status": "success",
+                "collector_hpke_config": _b64(keypair.config.encode())}
+
+    def _collection_start(self, req: dict) -> dict:
+        from janus_tpu.collector import Collector
+
+        task_id = TaskId.from_str(req["task_id"])
+        with self._lock:
+            task = self._tasks[bytes(task_id)]
+        q = req["query"]
+        if _num(q["type"]) == 1:
+            query = Query.time_interval(Interval(
+                Time(_num(q["batch_interval_start"])),
+                Duration(_num(q["batch_interval_duration"]))))
+        elif q.get("subtype") is not None and _num(q["subtype"]) == 0:
+            query = Query.fixed_size(FixedSizeQuery(
+                FixedSizeQuery.BY_BATCH_ID, BatchId(_unb64(q["batch_id"]))))
+        else:
+            query = Query.fixed_size(FixedSizeQuery(FixedSizeQuery.CURRENT_BATCH))
+        agg_param = _unb64(req.get("agg_param") or "")
+        collector = Collector(task_id, task["leader"], task["auth_token"],
+                              task["keypair"], task["vdaf"])
+        job_id = collector.start_collection(query, agg_param)
+        with self._lock:
+            handle = f"collect-{self._next_handle}"
+            self._next_handle += 1
+            self._handles[handle] = {
+                "collector": collector, "job_id": job_id, "query": query,
+                "agg_param": agg_param, "vdaf": task["vdaf"],
+            }
+        return {"status": "success", "handle": handle}
+
+    def _collection_poll(self, req: dict) -> dict:
+        with self._lock:
+            st = self._handles[req["handle"]]
+        result = st["collector"].poll_once(st["job_id"], st["query"],
+                                           st["agg_param"])
+        if result is None:
+            return {"status": "in progress"}
+        pbs = result.partial_batch_selector
+        out = {
+            "status": "complete",
+            "report_count": result.report_count,
+            "interval_start": result.interval.start.seconds,
+            "interval_duration": result.interval.duration.seconds,
+            "result": format_result(st["vdaf"], result.aggregate_result),
+        }
+        if pbs.batch_identifier is not None:
+            out["batch_id"] = _b64(bytes(pbs.batch_identifier))
+        return out

@@ -36,6 +36,7 @@ class VdafInstance:
             "proofs", "bits", "length", "chunk_length"),
         "Prio3Histogram": ("length", "chunk_length"),
         "Poplar1": ("bits",),
+        "Prio3FixedPointBoundedL2VecSum": ("bitsize", "length", "chunk_length"),
         "Fake": ("rounds",),
         "FakeFailsPrepInit": (),
         "FakeFailsPrepStep": (),
@@ -83,6 +84,13 @@ class VdafInstance:
     @classmethod
     def prio3_histogram(cls, length: int, chunk_length: int) -> "VdafInstance":
         return cls("Prio3Histogram", (("length", length), ("chunk_length", chunk_length)))
+
+    @classmethod
+    def prio3_fixedpoint_boundedl2_vec_sum(cls, bitsize: int, length: int,
+                                           chunk_length: int) -> "VdafInstance":
+        return cls("Prio3FixedPointBoundedL2VecSum",
+                   (("bitsize", bitsize), ("length", length),
+                    ("chunk_length", chunk_length)))
 
     @classmethod
     def fake(cls, rounds: int = 1) -> "VdafInstance":
@@ -148,6 +156,9 @@ def vdaf_for_instance(inst: VdafInstance):
         )
     if k == "Prio3Histogram":
         return _prio3.new_histogram(inst.length, inst.chunk_length)
+    if k == "Prio3FixedPointBoundedL2VecSum":
+        return _prio3.new_fixedpoint_boundedl2_vec_sum(
+            inst.length, inst.bitsize, inst.chunk_length)
     if k == "Fake":
         if inst.rounds != 1:
             raise NotImplementedError("DummyVdaf supports exactly 1 round")

@@ -145,31 +145,37 @@ class _BatchSum(_BatchCircuit):
         return f.sum_mod(f.mul(meas, jnp.asarray(weights)), axis=-1)[..., None, :]
 
 
+def _pad_chunks(elems, calls: int, chunk: int):
+    """Pad the element axis to calls*chunk and reshape to [..., calls, chunk, L]."""
+    n = elems.shape[-2]
+    pad = calls * chunk - n
+    if pad:
+        z = jnp.zeros(elems.shape[:-2] + (pad, elems.shape[-1]), dtype=elems.dtype)
+        elems = jnp.concatenate([elems, z], axis=-2)
+    return elems.reshape(elems.shape[:-2] + (calls, chunk, elems.shape[-1]))
+
+
+def _range_check_wires(f, elems, joint_rand, num_shares: int, calls: int,
+                       chunk: int):
+    """ParallelSum(Mul, chunk) range-check wires over an element vector:
+    per call, interleaved [r^(j+1)*e_j, e_j - 1/num_shares] pairs."""
+    chunks = _pad_chunks(elems, calls, chunk)  # [..., calls, chunk, L]
+    r = joint_rand[..., :calls, :]  # [..., calls, L]
+    rpow = _chain_powers(f, r, chunk)  # r^1..r^chunk
+    u = f.mul(rpow, chunks)
+    shares_inv = f.const(pow(num_shares, f.MODULUS - 2, f.MODULUS))
+    vwire = f.sub(chunks, jnp.broadcast_to(shares_inv, chunks.shape))
+    inter = jnp.stack([u, vwire], axis=-2)  # [..., calls, chunk, 2, L]
+    return inter.reshape(inter.shape[:-3] + (2 * chunk, inter.shape[-1]))
+
+
 class _BatchChunked(_BatchCircuit):
     """Shared wires for SumVec/Histogram: ParallelSum(Mul, chunk) range check."""
 
-    def _padded_elems(self, meas):
-        v = self.valid
-        calls, chunk = v._calls, v.chunk_length
-        pad = calls * chunk - v.MEAS_LEN
-        if pad:
-            z = jnp.zeros(meas.shape[:-2] + (pad, meas.shape[-1]), dtype=meas.dtype)
-            meas = jnp.concatenate([meas, z], axis=-2)
-        return meas.reshape(meas.shape[:-2] + (calls, chunk, meas.shape[-1]))
-
     def wires(self, meas, joint_rand, num_shares):
-        f = self.f
         v = self.valid
-        calls, chunk = v._calls, v.chunk_length
-        elems = self._padded_elems(meas)  # [..., calls, chunk, L]
-        r = joint_rand[..., :calls, :]  # [..., calls, L]
-        rpow = _chain_powers(f, r, chunk)  # [..., calls, chunk, L] (r^1..r^chunk)
-        u = f.mul(rpow, elems)
-        shares_inv = f.const(pow(num_shares, f.MODULUS - 2, f.MODULUS))
-        vwire = f.sub(elems, jnp.broadcast_to(shares_inv, elems.shape))
-        # oracle wire order per call: [u_0, v_0, u_1, v_1, ...]
-        inter = jnp.stack([u, vwire], axis=-2)  # [..., calls, chunk, 2, L]
-        return inter.reshape(inter.shape[:-3] + (2 * chunk, inter.shape[-1]))
+        return _range_check_wires(self.f, meas, joint_rand, num_shares,
+                                  v._calls, v.chunk_length)
 
 
 class _BatchSumVec(_BatchChunked):
@@ -199,11 +205,58 @@ class _BatchHistogram(_BatchChunked):
         return meas
 
 
+class _BatchFixedPoint(_BatchCircuit):
+    """FixedPointBoundedL2VecSum: joint-rand-weighted bit checks plus entry
+    squares through the one ParallelSum(Mul) gadget (flp.py docstring)."""
+
+    def _entry_values(self, meas):
+        f = self.f
+        v = self.valid
+        ent = meas[..., : v.length * v.bits, :]
+        ent = ent.reshape(ent.shape[:-2] + (v.length, v.bits, ent.shape[-1]))
+        weights = jnp.asarray(f.pack([1 << i for i in range(v.bits)]))
+        return f.sum_mod(f.mul(ent, weights), axis=-1)  # [..., length, L]
+
+    def wires(self, meas, joint_rand, num_shares):
+        v = self.valid
+        chunk = v.chunk_length
+        bit_wires = _range_check_wires(self.f, meas, joint_rand, num_shares,
+                                       v._calls_bits, chunk)
+        # square wires: (v_i, v_i) pairs through the same gadget
+        vals = _pad_chunks(self._entry_values(meas), v._calls_sq, chunk)
+        sq = jnp.stack([vals, vals], axis=-2)  # [..., cs, chunk, 2, L]
+        sq_wires = sq.reshape(sq.shape[:-3] + (2 * chunk, sq.shape[-1]))
+        return jnp.concatenate([bit_wires, sq_wires], axis=-3)
+
+    def output(self, gadget_outs, meas, joint_rand, num_shares):
+        f = self.f
+        v = self.valid
+        cb = v._calls_bits
+        range_check = f.sum_mod(gadget_outs[..., :cb, :], axis=-1)
+        sq_sum = f.sum_mod(gadget_outs[..., cb:, :], axis=-1)
+        vals = self._entry_values(meas)
+        lin = f.sum_mod(vals, axis=-1)
+        norm_bits = meas[..., v.length * v.bits :, :]
+        nweights = jnp.asarray(f.pack([1 << i for i in range(v.bits_for_norm)]))
+        claimed = f.sum_mod(f.mul(norm_bits, nweights), axis=-1)
+        shares_inv = pow(num_shares, f.MODULUS - 2, f.MODULUS)
+        offset = f.const(
+            shares_inv * ((v.length << (2 * v.bits - 2)) % f.MODULUS) % f.MODULUS)
+        computed = f.add(f.sub(sq_sum, f.mul_const(lin, 1 << v.bits)),
+                         jnp.broadcast_to(offset, sq_sum.shape))
+        norm_diff = f.sub(claimed, computed)
+        return f.add(range_check, f.mul(joint_rand[..., cb, :], norm_diff))
+
+    def truncate(self, meas):
+        return self._entry_values(meas)
+
+
 _CIRCUITS = {
     _flp.Count: _BatchCount,
     _flp.Sum: _BatchSum,
     _flp.SumVec: _BatchSumVec,
     _flp.Histogram: _BatchHistogram,
+    _flp.FixedPointBoundedL2VecSum: _BatchFixedPoint,
 }
 
 

@@ -1,0 +1,328 @@
+"""Batched XofHmacSha256Aes128 device kernels: SHA-256, HMAC, AES-128-CTR.
+
+Device-side form of janus_tpu.vdaf.xof.XofHmacSha256Aes128 (the multiproof
+XOF the reference consumes from prio — core/src/vdaf.rs:24,184-188): per
+stream, mac = HMAC-SHA256(key=seed, msg=len(dst)||dst||binder) and the
+keystream is AES-128-CTR(key=mac[0:16], iv=mac[16:32]).
+
+Everything is u8/u32 elementwise math plus small static-table gathers
+(AES S-box via jnp.take), vectorized over the report batch; all message
+lengths are static so padding happens at trace time.  Bit-exactness against
+the host oracle is pinned in tests/test_hmac_aes.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+
+# ---------------------------------------------------------------------------
+# SHA-256 (FIPS 180-4)
+# ---------------------------------------------------------------------------
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19], dtype=np.uint32)
+
+
+def _rotr(x, n: int):
+    return (x >> _U32(n)) | (x << _U32(32 - n))
+
+
+def _compress(state, block_words):
+    """One SHA-256 compression: state [..., 8], block [..., 16] u32 (BE words).
+
+    Rounds run under lax.scan (compile-time discipline: an unrolled 64-round
+    graph per block makes XLA compiles explode on multi-block messages); the
+    carry holds the working variables plus a 16-word schedule shift register.
+    """
+    ks = jnp.asarray(_K)
+
+    def round_fn(carry, k_t):
+        vars_, window = carry  # [..., 8], [..., 16]
+        w_t = window[..., 0]
+        a, b, c, d, e, f, g, h = [vars_[..., i] for i in range(8)]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_t + w_t
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        new_vars = jnp.stack(
+            [t1 + s0 + maj, a, b, c, d + t1, e, f, g], axis=-1)
+        # extend the schedule: w[t+16] from the current window
+        w1, w14 = window[..., 1], window[..., 14]
+        sig0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> _U32(3))
+        sig1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> _U32(10))
+        w_next = window[..., 0] + sig0 + window[..., 9] + sig1
+        window = jnp.concatenate([window[..., 1:], w_next[..., None]], axis=-1)
+        return (new_vars, window), None
+
+    (vars_, _), _ = jax.lax.scan(round_fn, (state, block_words), ks)
+    return state + vars_
+
+
+def _bytes_to_be_words(msg):
+    """u8 [..., 4k] -> big-endian u32 words [..., k]."""
+    b = msg.reshape(msg.shape[:-1] + (msg.shape[-1] // 4, 4)).astype(_U32)
+    return ((b[..., 0] << _U32(24)) | (b[..., 1] << _U32(16))
+            | (b[..., 2] << _U32(8)) | b[..., 3])
+
+
+def _be_words_to_bytes(words):
+    """u32 [..., k] -> u8 [..., 4k] big-endian."""
+    parts = [
+        (words >> _U32(24)).astype(_U8),
+        ((words >> _U32(16)) & _U32(0xFF)).astype(_U8),
+        ((words >> _U32(8)) & _U32(0xFF)).astype(_U8),
+        (words & _U32(0xFF)).astype(_U8),
+    ]
+    return jnp.stack(parts, axis=-1).reshape(words.shape[:-1] + (4 * words.shape[-1],))
+
+
+def sha256(msg):
+    """Batched SHA-256 of same-length messages: u8 [..., L] -> u8 [..., 32].
+
+    L is static; padding is computed at trace time."""
+    batch_shape = msg.shape[:-1]
+    L = msg.shape[-1]
+    npad = (-(L + 9)) % 64
+    tail = np.zeros(1 + npad + 8, dtype=np.uint8)
+    tail[0] = 0x80
+    bitlen = 8 * L
+    tail[-8:] = np.frombuffer(bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    padded = jnp.concatenate(
+        [msg, jnp.broadcast_to(jnp.asarray(tail), batch_shape + (len(tail),))],
+        axis=-1)
+    nblocks = padded.shape[-1] // 64
+    words = _bytes_to_be_words(padded).reshape(batch_shape + (nblocks, 16))
+    state = jnp.broadcast_to(jnp.asarray(_H0), batch_shape + (8,))
+    if nblocks == 1:
+        state = _compress(state, words[..., 0, :])
+    else:
+        # scan over blocks (blocks axis moved to the front for scan)
+        blocks = jnp.moveaxis(words, -2, 0)
+        state, _ = jax.lax.scan(
+            lambda st, blk: (_compress(st, blk), None), state, blocks)
+    return _be_words_to_bytes(state)
+
+
+def hmac_sha256(key, msg):
+    """Batched HMAC-SHA256: key u8 [..., <=64], msg u8 [..., L] -> [..., 32]."""
+    batch_shape = key.shape[:-1]
+    klen = key.shape[-1]
+    assert klen <= 64, "keys longer than the block are not needed here"
+    pad = jnp.zeros(batch_shape + (64 - klen,), dtype=_U8)
+    k = jnp.concatenate([key.astype(_U8), pad], axis=-1)
+    inner = sha256(jnp.concatenate([k ^ _U8(0x36), msg], axis=-1))
+    return sha256(jnp.concatenate([k ^ _U8(0x5C), inner], axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# AES-128 (FIPS 197) — CTR keystream
+# ---------------------------------------------------------------------------
+
+
+def _make_sbox() -> np.ndarray:
+    # Derive the S-box from GF(2^8) inversion + affine map (no table
+    # transcription): standard construction.
+    def gmul(a, b):
+        r = 0
+        for _ in range(8):
+            if b & 1:
+                r ^= a
+            hi = a & 0x80
+            a = (a << 1) & 0xFF
+            if hi:
+                a ^= 0x1B
+            b >>= 1
+        return r
+
+    def gpow(a, e):
+        r, base = 1, a
+        while e:
+            if e & 1:
+                r = gmul(r, base)
+            base = gmul(base, base)
+            e >>= 1
+        return r
+
+    # inverse via Fermat: a^254 in GF(2^8) (a^255 == 1 for a != 0)
+    inv = [0] + [gpow(x, 254) for x in range(1, 256)]
+    sbox = np.zeros(256, dtype=np.uint8)
+    for x in range(256):
+        b = inv[x]
+        s = 0
+        for i in range(8):
+            bit = ((b >> i) ^ (b >> ((i + 4) % 8)) ^ (b >> ((i + 5) % 8))
+                   ^ (b >> ((i + 6) % 8)) ^ (b >> ((i + 7) % 8)) ^ (0x63 >> i)) & 1
+            s |= bit << i
+        sbox[x] = s
+    return sbox
+
+
+_SBOX = _make_sbox()
+_RCON = np.array([0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36],
+                 dtype=np.uint8)
+
+
+def _sub_bytes(x):
+    return jnp.take(jnp.asarray(_SBOX), x.astype(jnp.int32), axis=0).astype(_U8)
+
+
+def _xtime(x):
+    return ((x << _U8(1)) ^ ((x >> _U8(7)) * _U8(0x1B))).astype(_U8)
+
+
+def aes128_key_schedule(key):
+    """key u8 [..., 16] -> 11 round keys u8 [..., 11, 16].
+
+    One scan step per round key (the carry is the previous round key)."""
+    rcons = jnp.asarray(_RCON)
+
+    def step(rk, rcon):
+        # rk [..., 16]; words w0..w3 -> next four words
+        prev = rk[..., 12:16]
+        rot = jnp.concatenate([prev[..., 1:], prev[..., :1]], axis=-1)
+        sub = _sub_bytes(rot)
+        rcon_vec = jnp.zeros_like(sub).at[..., 0].set(rcon.astype(_U8))
+        w0 = rk[..., 0:4] ^ sub ^ rcon_vec
+        w1 = rk[..., 4:8] ^ w0
+        w2 = rk[..., 8:12] ^ w1
+        w3 = rk[..., 12:16] ^ w2
+        nxt = jnp.concatenate([w0, w1, w2, w3], axis=-1)
+        return nxt, nxt
+
+    _, rks = jax.lax.scan(step, key.astype(_U8), rcons)
+    rks = jnp.moveaxis(rks, 0, -2)  # [..., 10, 16]
+    return jnp.concatenate([key.astype(_U8)[..., None, :], rks], axis=-2)
+
+
+# ShiftRows on the flat byte layout (byte i of the block maps to AES state
+# cell [row=i%4, col=i//4]; row r rotates left by r).
+_SHIFT_IDX = np.array([(i + 4 * (i % 4)) % 16 for i in range(16)], dtype=np.int32)
+
+
+def _aes_rounds(block, round_keys):
+    """block u8 [..., 16], round_keys [..., 11, 16] -> encrypted block.
+
+    Nine scanned middle rounds + the final (no-MixColumns) round."""
+    shift = jnp.asarray(_SHIFT_IDX)
+    s = block ^ round_keys[..., 0, :]
+    mid_keys = jnp.moveaxis(round_keys[..., 1:10, :], -2, 0)  # [9, ..., 16]
+
+    def round_fn(state, rk):
+        state = _sub_bytes(state)
+        state = jnp.take(state, shift, axis=-1)
+        cols = state.reshape(state.shape[:-1] + (4, 4))  # [..., col, row]
+        a0, a1, a2, a3 = (cols[..., 0], cols[..., 1], cols[..., 2],
+                          cols[..., 3])
+        x0, x1, x2, x3 = _xtime(a0), _xtime(a1), _xtime(a2), _xtime(a3)
+        m0 = x0 ^ (x1 ^ a1) ^ a2 ^ a3
+        m1 = a0 ^ x1 ^ (x2 ^ a2) ^ a3
+        m2 = a0 ^ a1 ^ x2 ^ (x3 ^ a3)
+        m3 = (x0 ^ a0) ^ a1 ^ a2 ^ x3
+        state = jnp.stack([m0, m1, m2, m3], axis=-1).reshape(state.shape)
+        return state ^ rk, None
+
+    s, _ = jax.lax.scan(round_fn, s, mid_keys)
+    s = _sub_bytes(s)
+    s = jnp.take(s, shift, axis=-1)
+    return s ^ round_keys[..., 10, :]
+
+
+def aes128_ctr(key, iv, n_bytes: int):
+    """Batched AES-128-CTR keystream: key/iv u8 [..., 16] -> u8 [..., n_bytes].
+
+    The 16-byte IV is the initial big-endian counter block (OpenSSL/CTR mode
+    semantics, matching cryptography's modes.CTR)."""
+    batch_shape = key.shape[:-1]
+    n_blocks = (n_bytes + 15) // 16
+    rks = aes128_key_schedule(key)
+    # counter = iv + block_index with big-endian carry, via 4 BE u32 limbs
+    iv_words = _bytes_to_be_words(iv)  # [..., 4], word 3 least significant
+    idx = jnp.arange(n_blocks, dtype=_U32)
+    w3 = iv_words[..., 3, None] + idx
+    carry3 = (w3 < iv_words[..., 3, None]).astype(_U32)
+    w2 = iv_words[..., 2, None] + carry3
+    carry2 = (w2 < iv_words[..., 2, None]).astype(_U32)
+    w1 = iv_words[..., 1, None] + carry2
+    carry1 = (w1 < iv_words[..., 1, None]).astype(_U32)
+    w0 = iv_words[..., 0, None] + carry1
+    counters = jnp.stack([w0, w1, w2, w3], axis=-1)  # [..., n_blocks, 4]
+    counter_bytes = _be_words_to_bytes(counters)  # [..., n_blocks, 16]
+    rks_b = jnp.broadcast_to(rks[..., None, :, :],
+                             batch_shape + (n_blocks, 11, 16))
+    stream = _aes_rounds(counter_bytes, rks_b)
+    return stream.reshape(batch_shape + (n_blocks * 16,))[..., :n_bytes]
+
+
+# ---------------------------------------------------------------------------
+# the XOF: HMAC key derivation + CTR keystream + field sampling
+# ---------------------------------------------------------------------------
+
+
+def _assemble(batch_shape: tuple, parts):
+    """Concatenate static bytes / per-report u8 arrays into one message."""
+    segs = []
+    for p in parts:
+        if isinstance(p, (bytes, bytearray)):
+            if len(p) == 0:
+                continue
+            arr = jnp.asarray(np.frombuffer(bytes(p), dtype=np.uint8))
+            segs.append(jnp.broadcast_to(arr, batch_shape + (len(p),)))
+        else:
+            p = jnp.asarray(p, dtype=_U8)
+            segs.append(p.reshape(batch_shape + (-1,)))
+    if not segs:
+        return jnp.zeros(batch_shape + (0,), dtype=_U8)
+    return jnp.concatenate(segs, axis=-1)
+
+
+def xof_stream(batch_shape: tuple, seed, msg_parts, n_bytes: int):
+    """Batched XofHmacSha256Aes128: seed u8 [..., 32] (or static bytes),
+    message segments as in xof_batch.build_blocks -> keystream u8 [..., n]."""
+    if isinstance(seed, (bytes, bytearray)):
+        seed = jnp.broadcast_to(
+            jnp.asarray(np.frombuffer(bytes(seed), dtype=np.uint8)),
+            batch_shape + (len(seed),))
+    else:
+        seed = jnp.asarray(seed, dtype=_U8).reshape(batch_shape + (-1,))
+    msg = _assemble(batch_shape, msg_parts)
+    mac = hmac_sha256(seed, msg)
+    return aes128_ctr(mac[..., :16], mac[..., 16:32], n_bytes)
+
+
+def derive_seed(batch_shape: tuple, seed, msg_parts, seed_size: int = 32):
+    return xof_stream(batch_shape, seed, msg_parts, seed_size)
+
+
+_P64 = (1 << 64) - (1 << 32) + 1
+
+
+def expand_field64(batch_shape: tuple, seed, msg_parts, n: int):
+    """Sample n Field64 elements per report (speculative rejection sampling,
+    same contract as xof_batch.expand_field64)."""
+    stream = xof_stream(batch_shape, seed, msg_parts, 8 * n)
+    le = stream.reshape(batch_shape + (n, 2, 4)).astype(_U32)
+    limbs = (le[..., 0] | (le[..., 1] << _U32(8))
+             | (le[..., 2] << _U32(16)) | (le[..., 3] << _U32(24)))
+    lo, hi = limbs[..., 0], limbs[..., 1]
+    bad = (hi == _U32(0xFFFFFFFF)) & (lo >= _U32(1))
+    return limbs, jnp.any(bad, axis=-1)

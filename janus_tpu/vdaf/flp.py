@@ -382,6 +382,121 @@ class Histogram(Valid):
         return list(output)
 
 
+class FixedPointBoundedL2VecSum(Valid):
+    """Prio3FixedPointBoundedL2VecSum: vector of fixed-point values in [-1, 1)
+    with L2 norm < 1 (reference instance: core/src/vdaf.rs:88, feature
+    fpvec_bounded_l2; the circuit follows the CGB17-style construction the
+    reference consumes from prio's fixedpoint_l2 module).
+
+    Encoding: entry x -> v = round(x * 2^(bits-1)) + 2^(bits-1) in [0, 2^bits);
+    measurement = bits of every v plus bits of the claimed squared norm
+    (2*bits - 2 bits, so claimed norm < 2^(2bits-2) == norm bound).
+    One ParallelSum(Mul) gadget carries BOTH constraint families: the first
+    `_calls_bits` calls are joint-rand-weighted bit checks over all
+    measurement bits; the remaining `_calls_sq` calls compute entry squares
+    (v_i, v_i) for the norm identity
+        sum x_i^2 = sum v_i^2 - 2^bits * sum v_i + length * 2^(2bits-2),
+    which must equal the claimed norm (combined with one extra joint-rand
+    element).
+    """
+
+    def __init__(self, length: int, bits: int = 16, chunk_length: int | None = None,
+                 field: type[Field] = Field128):
+        assert length > 0 and 1 < bits <= 32
+        self.field = field
+        self.length = length
+        self.bits = bits
+        self.bits_for_norm = 2 * bits - 2
+        self.MEAS_LEN = length * bits + self.bits_for_norm
+        if chunk_length is None:
+            chunk_length = max(1, int(round(self.MEAS_LEN ** 0.5)))
+        self.chunk_length = chunk_length
+        self._calls_bits = (self.MEAS_LEN + chunk_length - 1) // chunk_length
+        self._calls_sq = (length + chunk_length - 1) // chunk_length
+        self.JOINT_RAND_LEN = self._calls_bits + 1
+        self.OUTPUT_LEN = length
+
+    def gadgets(self):
+        return [ParallelSum(Mul(), self.chunk_length)]
+
+    def gadget_calls(self):
+        return [self._calls_bits + self._calls_sq]
+
+    def _entry_values(self, meas):
+        f = self.field
+        out = []
+        for k in range(self.length):
+            acc = 0
+            for i in range(self.bits):
+                acc = f.add(acc, f.mul(1 << i, meas[k * self.bits + i]))
+            out.append(acc)
+        return out
+
+    def eval(self, gadget_fns, meas, joint_rand, num_shares):
+        f = self.field
+        shares_inv = f.inv(num_shares % f.MODULUS)
+        # joint-rand-weighted bit checks over ALL measurement bits
+        range_check = 0
+        for i in range(self._calls_bits):
+            r = joint_rand[i]
+            inputs = []
+            w = r
+            for j in range(self.chunk_length):
+                idx = i * self.chunk_length + j
+                elem = meas[idx] if idx < self.MEAS_LEN else 0
+                inputs.append(f.mul(w, elem))
+                inputs.append(f.sub(elem, shares_inv))
+                w = f.mul(w, r)
+            range_check = f.add(range_check, gadget_fns[0](inputs))
+        # entry squares through the same gadget
+        values = self._entry_values(meas)
+        sq_sum = 0
+        for i in range(self._calls_sq):
+            inputs = []
+            for j in range(self.chunk_length):
+                idx = i * self.chunk_length + j
+                e = values[idx] if idx < self.length else 0
+                inputs.append(e)
+                inputs.append(e)
+            sq_sum = f.add(sq_sum, gadget_fns[0](inputs))
+        lin = 0
+        for v in values:
+            lin = f.add(lin, v)
+        claimed = 0
+        for i in range(self.bits_for_norm):
+            claimed = f.add(claimed,
+                            f.mul(1 << i, meas[self.length * self.bits + i]))
+        offset = f.mul(shares_inv,
+                       (self.length << (2 * self.bits - 2)) % f.MODULUS)
+        computed = f.add(f.sub(sq_sum, f.mul(1 << self.bits, lin)), offset)
+        norm_diff = f.sub(claimed, computed)
+        return f.add(range_check,
+                     f.mul(joint_rand[self._calls_bits], norm_diff))
+
+    def encode(self, measurement):
+        assert len(measurement) == self.length
+        scale = 1 << (self.bits - 1)
+        vs = []
+        for x in measurement:
+            v = int(round(float(x) * scale)) + scale
+            assert 0 <= v < (1 << self.bits), "entry out of [-1, 1)"
+            vs.append(v)
+        norm = sum((v - scale) ** 2 for v in vs)
+        assert norm < (1 << self.bits_for_norm), "L2 norm out of bounds"
+        out = []
+        for v in vs:
+            out.extend((v >> i) & 1 for i in range(self.bits))
+        out.extend((norm >> i) & 1 for i in range(self.bits_for_norm))
+        return out
+
+    def truncate(self, meas):
+        return self._entry_values(meas)
+
+    def decode(self, output, num_measurements):
+        scale = 1 << (self.bits - 1)
+        return [(o - num_measurements * scale) / scale for o in output]
+
+
 # ---------------------------------------------------------------------------
 # the generic FLP
 # ---------------------------------------------------------------------------

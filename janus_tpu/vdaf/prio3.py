@@ -43,6 +43,9 @@ ALGO_PRIO3_SUM = 0x00000001
 ALGO_PRIO3_SUM_VEC = 0x00000002
 ALGO_PRIO3_HISTOGRAM = 0x00000003
 ALGO_PRIO3_SUM_VEC_FIELD64_MULTIPROOF_HMAC = 0xFFFF1003
+# Private codepoint for the fixed-point bounded-L2 family (the reference
+# consumes prio's draft implementation, which predates codepoint assignment).
+ALGO_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM = 0xFFFF1002
 
 NONCE_SIZE = 16
 
@@ -405,6 +408,16 @@ def new_histogram(length: int, chunk_length: int) -> Prio3:
     from janus_tpu.vdaf.flp import Histogram
 
     return Prio3(Flp(Histogram(length, chunk_length)), ALGO_PRIO3_HISTOGRAM)
+
+
+def new_fixedpoint_boundedl2_vec_sum(length: int, bits: int = 16,
+                                     chunk_length: int | None = None) -> Prio3:
+    """Prio3FixedPointBoundedL2VecSum (reference core/src/vdaf.rs:88,
+    feature fpvec_bounded_l2)."""
+    from janus_tpu.vdaf.flp import FixedPointBoundedL2VecSum
+
+    return Prio3(Flp(FixedPointBoundedL2VecSum(length, bits, chunk_length)),
+                 ALGO_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM)
 
 
 def new_sum_vec_field64_multiproof_hmac(

@@ -1,0 +1,135 @@
+"""Daemon-plane behaviors: lease contention under concurrent acquirers,
+job abandonment after repeated failures, garbage collection, and upload
+write batching (SURVEY.md §5.2, §5.3; reference job_driver.rs,
+aggregation_job_driver.rs:703, garbage_collector.rs)."""
+
+import threading
+
+from janus_tpu.aggregator.aggregation_job_creator import AggregationJobCreator
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.aggregator.garbage_collector import GarbageCollector
+from janus_tpu.aggregator.http_client import PeerClient
+from janus_tpu.core.retries import Backoff, HttpResult
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import models as m
+from janus_tpu.datastore.datastore import ephemeral_datastore
+from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+from janus_tpu.models import VdafInstance
+from janus_tpu.messages import Duration, Time
+
+
+def _leader_with_reports(n_reports=4, vdaf=None, report_expiry_age=None):
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          vdaf or VdafInstance.fake())
+    builder.with_report_expiry_age(report_expiry_age)
+    clock = MockClock(Time(1_700_000_000))
+    ds = ephemeral_datastore(clock)
+    task = builder.leader_view()
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(task))
+    from janus_tpu.datastore.models import LeaderStoredReport
+    from janus_tpu.messages import HpkeCiphertext, HpkeConfigId, ReportId, ReportMetadata
+
+    def put(tx):
+        for i in range(n_reports):
+            tx.put_client_report(LeaderStoredReport(
+                task_id=task.task_id,
+                metadata=ReportMetadata(ReportId(i.to_bytes(16, "big")),
+                                        clock.now()),
+                public_share=b"",
+                leader_extensions=(),
+                leader_input_share=bytes([i % 250]),
+                helper_encrypted_input_share=HpkeCiphertext(
+                    HpkeConfigId(1), b"enc", b"ct"),
+            ))
+
+    ds.run_tx("r", put)
+    return builder, task, clock, ds
+
+
+def test_concurrent_lease_acquisition_never_double_claims():
+    builder, task, clock, ds = _leader_with_reports(8)
+    creator = AggregationJobCreator(ds, 1, 2, batch_aggregation_shard_count=2)
+    n_jobs = creator.run_once()
+    assert n_jobs == 4
+
+    claimed: list = []
+    lock = threading.Lock()
+
+    def worker():
+        leases = ds.run_tx(
+            "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 10))
+        with lock:
+            claimed.extend(leases)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ids = [bytes(lease.leased.aggregation_job_id) for lease in claimed]
+    assert len(ids) == n_jobs
+    assert len(set(ids)) == n_jobs, "a lease was claimed twice"
+
+    # leases expire -> re-acquirable with bumped attempt counts
+    clock.advance(Duration(601))
+    again = ds.run_tx(
+        "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+            Duration(600), 10))
+    assert len(again) == n_jobs
+    assert all(lease.lease_attempts == 2 for lease in again)
+
+
+class _FailingPeer(PeerClient):
+    def __init__(self):
+        super().__init__(backoff=Backoff(0.0001, 0.001, 2, 0.001))
+        self.calls = 0
+
+    def send_to_helper(self, task, method, path, body, content_type):
+        self.calls += 1
+        raise OSError("connection refused")
+
+
+def test_abandonment_after_max_attempts():
+    builder, task, clock, ds = _leader_with_reports(2)
+    AggregationJobCreator(ds, 1, 10, batch_aggregation_shard_count=2).run_once()
+    peer = _FailingPeer()
+    driver = AggregationJobDriver(ds, peer_client=peer,
+                                  batch_aggregation_shard_count=2,
+                                  maximum_attempts_before_failure=2,
+                                  lease_duration_s=10)
+    for attempt in range(4):
+        leases = driver.acquirer(10)
+        for lease in leases:
+            try:
+                driver.stepper(lease)
+            except OSError:
+                # released for retry; lease expiry drives the next attempt
+                pass
+        clock.advance(Duration(11))
+
+    jobs = ds.run_tx(
+        "j", lambda tx: tx.get_aggregation_jobs_for_task(task.task_id))
+    assert len(jobs) == 1
+    assert jobs[0].state is m.AggregationJobState.ABANDONED
+    # terminated counters converged so collection gates won't hang
+    idents = ds.run_tx(
+        "b", lambda tx: tx.get_batch_aggregation_identifiers_for_task(task.task_id))
+    for ident in idents:
+        shards = ds.run_tx(
+            "b", lambda tx: tx.get_batch_aggregations(task.task_id, ident, b""))
+        assert (sum(ba.aggregation_jobs_created for ba in shards)
+                == sum(ba.aggregation_jobs_terminated for ba in shards))
+
+
+def test_garbage_collector_deletes_expired_artifacts():
+    builder, task, clock, ds = _leader_with_reports(
+        3, report_expiry_age=Duration(3600))
+    AggregationJobCreator(ds, 1, 10, batch_aggregation_shard_count=1).run_once()
+    gc = GarbageCollector(ds)
+    assert gc.run_once() == {"reports": 0, "aggregation": 0, "collection": 0}
+
+    clock.advance(Duration(7200))  # everything is now expired
+    counts = gc.run_once()
+    assert counts["reports"] == 3
+    assert counts["aggregation"] >= 1

@@ -121,6 +121,17 @@ def test_fixed_size_end_to_end():
               VdafInstance.prio3_count(), [1, 1, 0, 1], 3)
 
 
+def test_time_interval_fixedpoint_end_to_end():
+    """Prio3FixedPointBoundedL2VecSum (BASELINE configs[4] family)."""
+    _run_pair(
+        QueryTypeCfg.time_interval(),
+        VdafInstance.prio3_fixedpoint_boundedl2_vec_sum(
+            bitsize=8, length=3, chunk_length=4),
+        [[0.5, -0.25, 0.125], [0.0, 0.75, -0.5]],
+        pytest.approx([0.5, 0.5, -0.375]),
+    )
+
+
 def test_time_interval_multiproof_end_to_end():
     """The multiproof HmacSha256Aes128 family (BASELINE config)."""
     _run_pair(

@@ -1,0 +1,75 @@
+"""The multiproof HmacSha256Aes128 family runs on the device path and is
+bit-identical to the host oracle (VERDICT round-1 weak #5 / next-step #8;
+reference core/src/vdaf.rs:24,78,184-188)."""
+
+import numpy as np
+
+from janus_tpu.engine.batch import BatchPrio3
+from janus_tpu.vdaf import ping_pong, prio3
+
+
+def _reports(vdaf, verify_key, measurements):
+    nonces, pubs, hshares, lshares, inits = [], [], [], [], []
+    for i, meas in enumerate(measurements):
+        nonce = i.to_bytes(16, "big")
+        pub, ish = vdaf.shard(meas, nonce, bytes((i + j) % 256
+                                                 for j in range(vdaf.RAND_SIZE)))
+        _st, msg = ping_pong.leader_initialized(vdaf, verify_key, nonce, pub,
+                                                ish[0])
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        hshares.append(vdaf.encode_input_share(1, ish[1]))
+        lshares.append(vdaf.encode_input_share(0, ish[0]))
+        inits.append(msg)
+    return nonces, pubs, hshares, lshares, inits
+
+
+def test_multiproof_helper_device_matches_oracle():
+    vdaf = prio3.new_sum_vec_field64_multiproof_hmac(8, 1, 3, 2)
+    engine = BatchPrio3(vdaf)
+    assert engine.device_ok, "multiproof must take the device path now"
+    verify_key = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    meas = [[1, 0, 1, 0, 1, 1, 0, 0], [0] * 8, [1] * 8, [0, 1] * 4]
+    nonces, pubs, hshares, _l, inits = _reports(vdaf, verify_key, meas)
+
+    got = engine.helper_init_batch(verify_key, nonces, pubs, hshares, inits)
+    assert engine.fallback_count == 0
+    for i, rep in enumerate(got):
+        oracle = engine._host_helper(verify_key, nonces[i], pubs[i],
+                                     hshares[i], inits[i])
+        assert rep.status == oracle.status == "finished", (rep.error,
+                                                           oracle.error)
+        assert rep.outbound.encode() == oracle.outbound.encode()
+        assert np.array_equal(np.asarray(rep.out_share_raw),
+                              oracle.out_share_raw)
+
+
+def test_multiproof_leader_device_matches_oracle():
+    vdaf = prio3.new_sum_vec_field64_multiproof_hmac(8, 1, 3, 2)
+    engine = BatchPrio3(vdaf)
+    verify_key = b"\x09" * vdaf.VERIFY_KEY_SIZE
+    meas = [[1, 1, 0, 0, 1, 0, 1, 0], [1] * 8]
+    nonces, pubs, _h, lshares, _i = _reports(vdaf, verify_key, meas)
+
+    got = engine.leader_init_batch(verify_key, nonces, pubs, lshares)
+    for i, rep in enumerate(got):
+        oracle = engine._host_leader(verify_key, nonces[i], pubs[i], lshares[i])
+        assert rep.status == oracle.status == "continued"
+        assert rep.prep_share == oracle.prep_share
+        assert rep.outbound.encode() == oracle.outbound.encode()
+        assert np.array_equal(np.asarray(rep.out_share_raw),
+                              np.asarray(oracle.out_share_raw))
+
+
+def test_multiproof_bad_proof_rejected_on_device():
+    vdaf = prio3.new_sum_vec_field64_multiproof_hmac(4, 1, 2, 2)
+    engine = BatchPrio3(vdaf)
+    verify_key = bytes(vdaf.VERIFY_KEY_SIZE)
+    nonces, pubs, hshares, _l, inits = _reports(vdaf, verify_key, [[1, 0, 1, 1]])
+    # corrupt the leader's prep share verifier bytes
+    bad = bytearray(inits[0].prep_share)
+    bad[-1] ^= 1
+    inits[0] = ping_pong.PingPongMessage(
+        ping_pong.PingPongMessage.TYPE_INITIALIZE, prep_share=bytes(bad))
+    got = engine.helper_init_batch(verify_key, nonces, pubs, hshares, inits)
+    assert got[0].status == "failed"
