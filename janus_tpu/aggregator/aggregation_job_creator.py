@@ -49,11 +49,53 @@ class AggregationJobCreator:
         for task in tasks:
             if task.role is not Role.LEADER:
                 continue
-            created += self.create_jobs_for_task(task)
+            try:
+                created += self.create_jobs_for_task(task)
+            except Exception as e:
+                # one task's failure (e.g. a bad persisted parameter) must
+                # not starve every other task of job creation
+                from janus_tpu import trace
+
+                trace.error("aggregation job creation failed for task",
+                            task_id=str(task.task_id), error=str(e))
         return created
 
     def create_jobs_for_task(self, task) -> int:
+        # VDAFs with aggregation parameters (Poplar1) can only be aggregated
+        # once a collection job supplies the parameter (the reference creates
+        # these jobs on demand from collection state).
+        requires_param = task.vdaf.kind == "Poplar1"
+
         def txn(tx):
+            if requires_param:
+                # one creation pass per START collection job's parameter:
+                # reports are claimed per (report, param), and content is
+                # retained so later parameters (tree levels) can reuse it.
+                created = 0
+                seen: set[bytes] = set()
+                for cj in tx.get_collection_jobs_for_task(task.task_id):
+                    if (cj.state is not m.CollectionJobState.START
+                            or not cj.aggregation_parameter
+                            or cj.aggregation_parameter in seen):
+                        continue
+                    seen.add(cj.aggregation_parameter)
+                    from janus_tpu.aggregator.query_type import logic_for
+
+                    interval = logic_for(
+                        task.query_type.query_type).to_batch_interval(
+                        cj.batch_identifier)
+                    claimed = tx.get_unaggregated_client_reports_for_param(
+                        task.task_id, cj.aggregation_parameter, limit=5000,
+                        interval=interval)
+                    if not claimed:
+                        continue
+                    if task.query_type.query_type is FIXED_SIZE:
+                        created += self._create_fixed_size_for_param(
+                            tx, task, claimed, cj.aggregation_parameter)
+                    else:
+                        created += self._create_time_interval(
+                            tx, task, claimed, cj.aggregation_parameter)
+                return created
             claimed = tx.get_unaggregated_client_reports_for_task(
                 task.task_id, limit=5000)
             if not claimed:
@@ -66,7 +108,7 @@ class AggregationJobCreator:
 
     # -- time-interval (reference :538) ------------------------------------
 
-    def _create_time_interval(self, tx, task, claimed) -> int:
+    def _create_time_interval(self, tx, task, claimed, agg_param=b"") -> int:
         created = 0
         idx = 0
         while idx < len(claimed):
@@ -76,14 +118,15 @@ class AggregationJobCreator:
                 for rid, _t in chunk:
                     tx.mark_report_unaggregated(task.task_id, rid)
                 break
-            self._write_job(tx, task, chunk, partial_batch_identifier=None)
+            self._write_job(tx, task, chunk, partial_batch_identifier=None,
+                            aggregation_parameter=agg_param)
             created += 1
             idx += self.max_job
         return created
 
     # -- fixed-size (reference :712 + BatchCreator) ------------------------
 
-    def _create_fixed_size(self, tx, task, claimed) -> int:
+    def _create_fixed_size(self, tx, task, claimed, agg_param=b"") -> int:
         bc = BatchCreator(task, self.min_job, self.max_job)
         assignment = bc.assign(tx, claimed)
         created = 0
@@ -92,12 +135,43 @@ class AggregationJobCreator:
             while idx < len(reports):
                 chunk = reports[idx : idx + self.max_job]
                 self._write_job(tx, task, chunk,
-                                partial_batch_identifier=batch_id)
+                                partial_batch_identifier=batch_id,
+                                aggregation_parameter=agg_param)
                 created += 1
                 idx += self.max_job
         return created
 
-    def _write_job(self, tx, task, reports, partial_batch_identifier) -> None:
+    def _create_fixed_size_for_param(self, tx, task, claimed, agg_param) -> int:
+        """Later Poplar1 tree levels must reuse the batch membership the
+        reports were given at their first aggregation — re-running batch
+        assignment would scatter them into fresh batches and break by-batch-id
+        collection across levels."""
+        assigned = tx.get_report_batch_assignments(
+            task.task_id, [rid for rid, _t in claimed])
+        by_batch: dict = {}
+        fresh = []
+        for rid, t in claimed:
+            bid = assigned.get(bytes(rid))
+            if bid is None:
+                fresh.append((rid, t))
+            else:
+                by_batch.setdefault(bid, []).append((rid, t))
+        created = 0
+        for batch_id, reports in by_batch.items():
+            idx = 0
+            while idx < len(reports):
+                chunk = reports[idx : idx + self.max_job]
+                self._write_job(tx, task, chunk,
+                                partial_batch_identifier=batch_id,
+                                aggregation_parameter=agg_param)
+                created += 1
+                idx += self.max_job
+        if fresh:
+            created += self._create_fixed_size(tx, task, fresh, agg_param)
+        return created
+
+    def _write_job(self, tx, task, reports, partial_batch_identifier,
+                   aggregation_parameter=b"") -> None:
         from janus_tpu.aggregator.aggregation_job_writer import (
             AggregationJobWriter,
             WritableReportAggregation,
@@ -107,7 +181,8 @@ class AggregationJobCreator:
         job_id = AggregationJobId.random()
         times = [t for _rid, t in reports]
         job = m.AggregationJob(
-            task_id=task.task_id, id=job_id, aggregation_parameter=b"",
+            task_id=task.task_id, id=job_id,
+            aggregation_parameter=aggregation_parameter,
             partial_batch_identifier=partial_batch_identifier,
             client_timestamp_interval=batch_interval_spanning(times),
             state=m.AggregationJobState.IN_PROGRESS,
@@ -132,11 +207,15 @@ class AggregationJobCreator:
                 time=t, ord=ord_, state=state)))
         # InitialWrite through the job writer so the touched batch shards'
         # aggregation_jobs_created counters increment (collection readiness).
-        writer = AggregationJobWriter(task, prep_engine(task.vdaf),
-                                      shard_count=self.shard_count, initial=True)
+        writer = AggregationJobWriter(
+            task, prep_engine(task.vdaf).bind(aggregation_parameter),
+            shard_count=self.shard_count, initial=True)
         writer.write(tx, job, writables)
-        for rid in scrub:
-            tx.scrub_client_report(task.task_id, rid)
+        # Param-bearing VDAFs keep report content for later parameters
+        # (GC reclaims it); param-free VDAFs scrub immediately.
+        if not aggregation_parameter:
+            for rid in scrub:
+                tx.scrub_client_report(task.task_id, rid)
 
     # -- daemon loop -------------------------------------------------------
 

@@ -20,10 +20,12 @@ from janus_tpu.datastore import models as m
 from janus_tpu.datastore.datastore import Datastore
 from janus_tpu.messages import (
     AggregationJobContinueReq,
+    AggregationJobStep,
     Duration,
     AggregationJobInitializeReq,
     AggregationJobResp,
     PartialBatchSelector,
+    PrepareContinue,
     PrepareError,
     PrepareInit,
     PrepareResp,
@@ -33,6 +35,7 @@ from janus_tpu.messages import (
 )
 from janus_tpu.models.vdaf_instance import prep_engine
 from janus_tpu.vdaf import ping_pong
+from janus_tpu.vdaf.prio3 import VdafError
 
 
 class AggregationJobDriver:
@@ -86,14 +89,25 @@ class AggregationJobDriver:
             self._release(lease)
             return
 
-        engine = prep_engine(task.vdaf)
+        try:
+            engine = prep_engine(task.vdaf).bind(job.aggregation_parameter)
+        except VdafError as e:
+            from janus_tpu import trace
+
+            trace.error("aggregation job has an unusable aggregation "
+                        "parameter; releasing for abandonment",
+                        task_id=str(task_id), job_id=str(job_id), error=str(e))
+            self._release(lease)
+            return
         starts = [ra for ra in ras
                   if ra.state.kind is m.ReportAggregationStateKind.START_LEADER]
+        waiting = [ra for ra in ras
+                   if ra.state.kind is m.ReportAggregationStateKind.WAITING_LEADER]
         if starts:
             self._step_init(task, engine, job, ras, lease)
+        elif waiting:
+            self._step_continue(task, engine, job, ras, lease)
         else:
-            # Nothing to do (multi-round continuation plugs in here when a
-            # >1-round VDAF lands); mark finished if no report is waiting.
             self._finalize(task, engine, job, [
                 WritableReportAggregation(ra) for ra in ras
             ], lease)
@@ -105,9 +119,14 @@ class AggregationJobDriver:
         pubs = [ra.state.public_share for ra in starts]
         shares = [ra.state.leader_input_share for ra in starts]
 
-        # Device: batched leader prepare (reference per-report loop :344).
-        prepared = engine.leader_init_batch(task.vdaf_verify_key, nonces,
-                                            pubs, shares)
+        # Device: batched leader prepare (reference per-report loop :344,
+        # spanned like the reference's trace_span!("VDAF preparation")).
+        from janus_tpu import trace
+
+        with trace.span("VDAF preparation", task_id=str(task.task_id),
+                        reports=len(nonces)):
+            prepared = engine.leader_init_batch(task.vdaf_verify_key, nonces,
+                                                pubs, shares)
 
         prepare_inits = []
         continued = []  # (ra, PreparedReport)
@@ -176,8 +195,11 @@ class AggregationJobDriver:
                     ra.with_state(m.ReportAggregationState.finished()),
                     rep.out_share_raw, device_shares=rep.device_shares,
                     lane=rep.lane))
-            elif rep.status == "continued":
-                # multi-round: persist the transition for the next step
+            elif rep.status == "waiting":
+                # Multi-round VDAF: persist the transition; the NEXT leased
+                # step evaluates it and runs the continue exchange (the
+                # reference's WaitingLeader{transition} discipline keeps the
+                # protocol resumable across crashes/timeouts).
                 writables.append(WritableReportAggregation(
                     ra.with_state(m.ReportAggregationState.waiting_leader(
                         rep.prep_share or b""))))
@@ -195,6 +217,79 @@ class AggregationJobDriver:
         for ra in ras:
             if bytes(ra.report_id) not in handled:
                 writables.append(WritableReportAggregation(ra))
+
+        job = job.with_step(job.step.increment())
+        self._finalize(task, engine, job, writables, lease)
+
+    def _step_continue(self, task, engine, job, ras, lease) -> None:
+        """Evaluate persisted transitions, run one continue exchange, fold
+        the helper's responses.  Re-entrant: re-running after a lost response
+        re-sends byte-identical requests, which the helper re-serves via its
+        request-hash replay path."""
+        vdaf = engine.vdaf
+        writables: list[WritableReportAggregation] = []
+        continues = []  # (ra, outbound_msg, state_or_finished)
+        for ra in ras:
+            if ra.state.kind is not m.ReportAggregationStateKind.WAITING_LEADER:
+                writables.append(WritableReportAggregation(ra))
+                continue
+            try:
+                transition = vdaf.decode_transition(ra.state.leader_prep_transition)
+                state, outbound = transition.evaluate()
+                continues.append((ra, outbound, state))
+            except Exception:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.failed(
+                        PrepareError.VDAF_PREP_ERROR))))
+
+        helper_resp: dict[bytes, object] = {}
+        if continues:
+            # the leader's job.step already counts the completed init
+            # exchange, so it names the helper's next step directly
+            req = AggregationJobContinueReq(
+                step=AggregationJobStep(job.step.value),
+                prepare_continues=tuple(
+                    PrepareContinue(ra.report_id, outbound.encode())
+                    for ra, outbound, _state in continues),
+            )
+            result = self.peer.send_to_helper(
+                task, "POST", f"tasks/{task.task_id}/aggregation_jobs/{job.id}",
+                req.encode(), AggregationJobContinueReq.MEDIA_TYPE)
+            resp = AggregationJobResp.decode(result.body)
+            helper_resp = {bytes(pr.report_id): pr for pr in resp.prepare_resps}
+
+        for ra, outbound, state in continues:
+            pr = helper_resp.get(bytes(ra.report_id))
+            if pr is None or pr.result.kind == PrepareStepResult.REJECT:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.failed(
+                        PrepareError.VDAF_PREP_ERROR))))
+                continue
+            if state.finished:
+                writables.append(WritableReportAggregation(
+                    ra.with_state(m.ReportAggregationState.finished()),
+                    state.out_share))
+            else:
+                # >2-round VDAF: fold the helper's message into our state
+                # and persist the next transition.
+                try:
+                    from janus_tpu.vdaf import ping_pong
+
+                    msg = ping_pong.PingPongMessage.decode(pr.result.message)
+                    res = ping_pong.continued(vdaf, state, msg)
+                    if getattr(res, "finished", False):
+                        writables.append(WritableReportAggregation(
+                            ra.with_state(m.ReportAggregationState.finished()),
+                            res.out_share))
+                    else:
+                        writables.append(WritableReportAggregation(
+                            ra.with_state(
+                                m.ReportAggregationState.waiting_leader(
+                                    vdaf.encode_transition(res)))))
+                except Exception:
+                    writables.append(WritableReportAggregation(
+                        ra.with_state(m.ReportAggregationState.failed(
+                            PrepareError.VDAF_PREP_ERROR))))
 
         job = job.with_step(job.step.increment())
         self._finalize(task, engine, job, writables, lease)

@@ -392,6 +392,12 @@ class Aggregator:
 
         report_deadline = self.clock.now().add(task.tolerable_clock_skew)
 
+        try:
+            engine = ta.engine.bind(req.aggregation_parameter)
+        except VdafError as e:
+            raise err.InvalidMessage(f"bad aggregation parameter: {e}",
+                                     task_id) from e
+
         # Phase 1 (host): HPKE open + plaintext/message decode, per report.
         # Failures become per-lane PrepareErrors, never whole-batch aborts
         # (SURVEY.md §7 hard part 3).
@@ -454,9 +460,14 @@ class Aggregator:
             shares.append(pis.payload)
             inbounds.append(inbound)
 
-        # Phase 2 (device): one batched prepare over all surviving lanes.
-        prepared = ta.engine.helper_init_batch(
-            task.vdaf_verify_key, nonces, pubs, shares, inbounds)
+        # Phase 2 (device): one batched prepare over all surviving lanes
+        # (the reference's trace_span!("VDAF preparation"), aggregator.rs:1946).
+        from janus_tpu import trace
+
+        with trace.span("VDAF preparation", task_id=str(task_id),
+                        reports=len(nonces)):
+            prepared = engine.helper_init_batch(
+                task.vdaf_verify_key, nonces, pubs, shares, inbounds)
 
         # Phase 3: assemble per-report outcomes.
         writables: list[WritableReportAggregation] = []
@@ -521,23 +532,24 @@ class Aggregator:
                     ra.last_prep_resp for ra in ras if ra.last_prep_resp
                 ))
 
-            # Replay detection: a report share seen before (other jobs) fails.
+            # Replay detection, scoped to the aggregation parameter: the same
+            # report under a DIFFERENT parameter (Poplar1 tree levels) is not
+            # a replay (reference aggregator.rs:2100-2136).
             final = []
             for w in writables:
                 ra = w.report_aggregation
-                replayed = False
                 try:
                     tx.put_scrubbed_report(task_id, ra.report_id, ra.time)
                 except MutationTargetAlreadyExists:
-                    replayed = True
-                if replayed or tx.check_report_replayed(task_id, ra.report_id,
-                                                        job_id):
+                    pass  # the report-id row may exist from another parameter
+                if tx.check_report_replayed(task_id, ra.report_id, job_id,
+                                            req.aggregation_parameter):
                     if ra.state.kind is not m.ReportAggregationStateKind.FAILED:
                         w = w.with_failure(PrepareError.REPORT_REPLAYED)
                 final.append(w)
 
             writer = AggregationJobWriter(
-                task, ta.engine,
+                task, engine,
                 shard_count=self.cfg.batch_aggregation_shard_count,
                 initial=True)
             final = writer.write(tx, job, final)
@@ -592,6 +604,13 @@ class Aggregator:
                 f"leader sent step {req.step.value}, helper is at step "
                 f"{job.step.value}", task_id)
 
+        try:
+            engine = ta.engine.bind(job.aggregation_parameter)
+        except VdafError as e:
+            raise err.InvalidMessage(f"bad aggregation parameter: {e}",
+                                     task_id) from e
+        bound_vdaf = engine.vdaf
+
         by_id = {bytes(ra.report_id): ra for ra in ras}
         writables: list[WritableReportAggregation] = []
         seen_ids = set()
@@ -607,12 +626,32 @@ class Aggregator:
             if ra.state.kind is not m.ReportAggregationStateKind.WAITING_HELPER:
                 raise err.InvalidMessage(
                     "leader sent prepare step for non-waiting report", task_id)
-            # Multi-round continuation is oracle-driven (no 1-round VDAF
-            # reaches here; Poplar1 et al. plug in at this seam).
+            # Multi-round continuation: resume the persisted prep state and
+            # consume the leader's ping-pong message
+            # (reference aggregation_job_continue.rs:119).
             out_share = None
             try:
-                raise VdafError("multi-round VDAF continuation not supported")
-            except VdafError:
+                prep_state, rnd = bound_vdaf.decode_prep_state(
+                    ra.state.helper_prep_state)
+                cont = ping_pong.PingPongContinued(prep_state, rnd)
+                msg = ping_pong.PingPongMessage.decode(pc.message)
+                res = ping_pong.continued(bound_vdaf, cont, msg)
+                if getattr(res, "finished", False):
+                    state = m.ReportAggregationState.finished()
+                    result = PrepareStepResult.finished()
+                    out_share = res.out_share
+                else:
+                    nxt, outbound = res.evaluate()
+                    if nxt.finished:
+                        state = m.ReportAggregationState.finished()
+                        result = PrepareStepResult.continued(outbound.encode())
+                        out_share = nxt.out_share
+                    else:
+                        state = m.ReportAggregationState.waiting_helper(
+                            bound_vdaf.encode_prep_state(
+                                nxt.prep_state, nxt.current_round))
+                        result = PrepareStepResult.continued(outbound.encode())
+            except (VdafError, ValueError) as e:
                 state = m.ReportAggregationState.failed(PrepareError.VDAF_PREP_ERROR)
                 result = PrepareStepResult.rejected(PrepareError.VDAF_PREP_ERROR)
             ra = ra.with_state(state).with_last_prep_resp(
@@ -623,7 +662,7 @@ class Aggregator:
 
         def txn(tx):
             writer = AggregationJobWriter(
-                task, ta.engine,
+                task, engine,
                 shard_count=self.cfg.batch_aggregation_shard_count,
                 initial=False)
             final = writer.write(tx, job, writables)
@@ -665,6 +704,13 @@ class Aggregator:
             raise err.InvalidMessage(f"malformed request: {e}", task_id) from e
         if req.query.query_type is not task.query_type.query_type:
             raise err.InvalidMessage("query type mismatch", task_id)
+        # Reject malformed aggregation parameters at the door: they would
+        # otherwise wedge the creator/driver daemons that bind them later.
+        try:
+            ta.engine.bind(req.aggregation_parameter)
+        except VdafError as e:
+            raise err.InvalidMessage(f"bad aggregation parameter: {e}",
+                                     task_id) from e
 
         def txn(tx):
             # Existing-job check FIRST: a retried current-batch query must not
@@ -767,6 +813,11 @@ class Aggregator:
         ident = req.batch_selector.batch_identifier
         if not ta.logic.validate_collection_identifier(task, ident):
             raise err.BatchInvalid("misaligned batch interval", task_id)
+        try:
+            bound_vdaf = ta.engine.bind(req.aggregation_parameter).vdaf
+        except VdafError as e:
+            raise err.InvalidMessage(f"bad aggregation parameter: {e}",
+                                     task_id) from e
 
         def txn(tx):
             # Idempotency: a cached AggregateShareJob is re-served
@@ -790,7 +841,7 @@ class Aggregator:
                 shards.extend(tx.get_batch_aggregations(
                     task_id, batch_ident, req.aggregation_parameter))
             share, count, checksum, _interval = merge_batch_aggregations(
-                ta.vdaf, shards)
+                bound_vdaf, shards)
             if count < task.min_batch_size:
                 raise err.InvalidBatchSize(
                     f"batch has {count} reports, minimum is "
@@ -803,7 +854,7 @@ class Aggregator:
             asj = m.AggregateShareJob(
                 task_id=task_id, batch_identifier=ident,
                 aggregation_parameter=req.aggregation_parameter,
-                helper_aggregate_share=ta.vdaf.encode_agg_share(share),
+                helper_aggregate_share=bound_vdaf.encode_agg_share(share),
                 report_count=count, checksum=checksum,
             )
             tx.put_batch_query(task_id, ident, req.aggregation_parameter)

@@ -77,7 +77,7 @@ class CollectionJobDriver:
             self._release(lease, None)
             return
 
-        engine = prep_engine(task.vdaf)
+        engine = prep_engine(task.vdaf).bind(job.aggregation_parameter)
         vdaf = engine.vdaf
         logic = logic_for(task.query_type.query_type)
         batch_identifiers = logic.batch_identifiers_for_collection_identifier(
@@ -102,7 +102,15 @@ class CollectionJobDriver:
                 return None
             interval = logic.to_batch_interval(job.batch_identifier)
             if interval is not None:
-                if tx.count_unaggregated_reports_in_interval(task_id, interval):
+                if job.aggregation_parameter:
+                    # param-scoped pending check (Poplar1: reports retain
+                    # content for other parameters but must be aggregated
+                    # under THIS one before collection)
+                    if tx.count_unaggregated_reports_for_param_in_interval(
+                            task_id, job.aggregation_parameter, interval):
+                        return None
+                elif tx.count_unaggregated_reports_in_interval(task_id,
+                                                               interval):
                     return None
             for ba in shards:
                 if ba.state is m.BatchAggregationState.AGGREGATING:

@@ -69,6 +69,11 @@ def janus_main(argv, config_cls, run):
     parser.add_argument("--datastore-keys", action="append", default=None)
     args = parser.parse_args(argv)
     cfg = load_config(config_cls, args.config_file)
+    from janus_tpu.trace import TraceConfiguration, install_trace_subscriber
+
+    install_trace_subscriber(TraceConfiguration(
+        level=cfg.common.logging_level,
+        use_json=os.environ.get("JANUS_LOG_FORMAT") == "json"))
     ds = build_datastore(cfg.common, args.datastore_keys)
     health = None
     if cfg.common.health_check_listen_address:

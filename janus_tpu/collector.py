@@ -88,6 +88,10 @@ class Collector:
                 f"{resp.content[:200]!r}")
         collection = Collection.decode(resp.content)
 
+        vdaf = self.vdaf
+        if aggregation_parameter and hasattr(vdaf, "with_agg_param"):
+            vdaf = vdaf.with_agg_param(aggregation_parameter)
+
         batch_identifier = (
             query.query_body if query.query_type.NAME == "TimeInterval"
             else collection.partial_batch_selector.batch_identifier)
@@ -102,8 +106,8 @@ class Collector:
                 hpke.application_info(hpke.Label.AGGREGATE_SHARE, role,
                                       Role.COLLECTOR),
                 ct, aad)
-            shares.append(self.vdaf.decode_agg_share(plaintext))
-        result = self.vdaf.unshard(shares, collection.report_count)
+            shares.append(vdaf.decode_agg_share(plaintext))
+        result = vdaf.unshard(shares, collection.report_count)
         return CollectionResult(
             partial_batch_selector=collection.partial_batch_selector,
             report_count=collection.report_count,

@@ -479,6 +479,68 @@ class Transaction:
         ).fetchall()
         return [(ReportId(r[0]), Time(r[1])) for r in rows]
 
+    def get_unaggregated_client_reports_for_param(
+        self, task_id: TaskId, aggregation_parameter: bytes, limit: int = 5000,
+        interval: Interval | None = None
+    ) -> list[tuple[ReportId, Time]]:
+        """Reports (with content) not yet aggregated under THIS aggregation
+        parameter — VDAFs with parameters (Poplar1) aggregate the same report
+        once per parameter (reference keys replay state on (report, param)).
+        `interval` scopes the claim to the collection being driven."""
+        sql = """SELECT cr.report_id, cr.client_timestamp FROM client_reports cr
+               WHERE cr.task_id = ? AND cr.leader_input_share IS NOT NULL
+                 AND NOT EXISTS (
+                   SELECT 1 FROM report_aggregations ra
+                   JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
+                    AND ra.aggregation_job_id = aj.aggregation_job_id
+                   WHERE ra.task_id = cr.task_id AND ra.report_id = cr.report_id
+                     AND aj.aggregation_param = ?)"""
+        params: list = [bytes(task_id), aggregation_parameter]
+        if interval is not None:
+            sql += " AND cr.client_timestamp >= ? AND cr.client_timestamp < ?"
+            params += [interval.start.seconds, interval.end().seconds]
+        sql += " ORDER BY cr.client_timestamp LIMIT ?"
+        params.append(limit)
+        rows = self._exec(sql, tuple(params)).fetchall()
+        return [(ReportId(r[0]), Time(r[1])) for r in rows]
+
+    def get_report_batch_assignments(self, task_id: TaskId,
+                                     report_ids: list[ReportId]) -> dict:
+        """report id bytes -> BatchId from the report's first fixed-size
+        aggregation, for batch-membership reuse across Poplar1 levels."""
+        out: dict[bytes, BatchId] = {}
+        for rid in report_ids:
+            row = self._exec(
+                """SELECT aj.batch_id FROM report_aggregations ra
+                   JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
+                    AND ra.aggregation_job_id = aj.aggregation_job_id
+                   WHERE ra.task_id = ? AND ra.report_id = ?
+                     AND aj.batch_id IS NOT NULL LIMIT 1""",
+                (bytes(task_id), bytes(rid)),
+            ).fetchone()
+            if row is not None:
+                out[bytes(rid)] = BatchId(row[0])
+        return out
+
+    def count_unaggregated_reports_for_param_in_interval(
+        self, task_id: TaskId, aggregation_parameter: bytes,
+        interval: Interval
+    ) -> int:
+        row = self._exec(
+            """SELECT COUNT(*) FROM client_reports cr
+               WHERE cr.task_id = ? AND cr.leader_input_share IS NOT NULL
+                 AND cr.client_timestamp >= ? AND cr.client_timestamp < ?
+                 AND NOT EXISTS (
+                   SELECT 1 FROM report_aggregations ra
+                   JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
+                    AND ra.aggregation_job_id = aj.aggregation_job_id
+                   WHERE ra.task_id = cr.task_id AND ra.report_id = cr.report_id
+                     AND aj.aggregation_param = ?)""",
+            (bytes(task_id), interval.start.seconds, interval.end().seconds,
+             aggregation_parameter),
+        ).fetchone()
+        return row[0]
+
     def mark_report_unaggregated(self, task_id: TaskId, report_id: ReportId) -> None:
         self._exec(
             """UPDATE client_reports SET aggregation_started = 0
@@ -755,14 +817,21 @@ class Transaction:
         return out
 
     def check_report_replayed(self, task_id: TaskId, report_id: ReportId,
-                              exclude_job: AggregationJobId) -> bool:
-        """Has this report id been aggregated under a different job?
-        (reference replay check, aggregator.rs:2100-2136)"""
+                              exclude_job: AggregationJobId,
+                              aggregation_parameter: bytes = b"") -> bool:
+        """Has this report id been aggregated under a different job with the
+        SAME aggregation parameter?  (reference
+        check_other_report_aggregation_exists, aggregator.rs:2100-2136 —
+        param-scoped so Poplar1 reports can serve multiple tree levels.)"""
         return self._exec(
-            """SELECT 1 FROM report_aggregations
-               WHERE task_id = ? AND report_id = ? AND aggregation_job_id != ?
+            """SELECT 1 FROM report_aggregations ra
+               JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
+                AND ra.aggregation_job_id = aj.aggregation_job_id
+               WHERE ra.task_id = ? AND ra.report_id = ?
+                 AND ra.aggregation_job_id != ? AND aj.aggregation_param = ?
                LIMIT 1""",
-            (bytes(task_id), bytes(report_id), bytes(exclude_job)),
+            (bytes(task_id), bytes(report_id), bytes(exclude_job),
+             aggregation_parameter),
         ).fetchone() is not None
 
     # -- batch aggregations (sharded accumulators) ------------------------

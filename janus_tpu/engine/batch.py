@@ -84,15 +84,34 @@ class _HmacXofOps:
             bs, seed, [xof_batch.xof_prefix(dst)] + list(binder_parts), n)
 
 
+class LaneRef:
+    """A lazy reference to one lane of an on-device batch tensor.
+
+    Constructing it is free — no device operation is issued (on a remote
+    device every eager op is a round trip, so per-lane slicing in the result
+    loop would cost thousands of them).  `np.asarray(ref)` materializes just
+    that lane when host code genuinely needs the values.
+    """
+
+    __slots__ = ("array", "lane")
+
+    def __init__(self, array, lane: int):
+        self.array = array
+        self.lane = lane
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.asarray(self.array[self.lane])
+        return out.astype(dtype) if dtype is not None else out
+
+
 @dataclass
 class PreparedReport:
     """Per-report outcome of a batched prepare step.
 
-    `out_share_raw` may be a LAZY on-device slice (jax array): output shares
-    stay in HBM end-to-end and only per-batch aggregates cross the
-    host<->device boundary (`device_shares`/`lane` let the aggregation path
-    mask-reduce the whole batch without per-lane transfers).  np.asarray()
-    materializes a single lane when host code genuinely needs it.
+    `out_share_raw` may be a lazy `LaneRef` into the resident device batch:
+    output shares stay in HBM end-to-end and only per-batch aggregates cross
+    the host<->device boundary (`device_shares`/`lane` let the aggregation
+    path mask-reduce the whole batch without per-lane transfers).
     """
 
     status: str  # "finished" | "continued" | "failed"
@@ -154,6 +173,12 @@ class BatchPrio3:
         self._leader_fns: dict[int, object] = {}
         self._agg_fn = None
         self.fallback_count = 0  # reports recomputed on host (observability)
+
+    def bind(self, agg_param: bytes) -> "BatchPrio3":
+        """Prio3 takes no aggregation parameter; binding is a no-op."""
+        if agg_param:
+            raise VdafError("Prio3 takes no aggregation parameter")
+        return self
 
     def _bucket(self, n: int) -> int:
         from janus_tpu.parallel import round_up
@@ -478,7 +503,8 @@ class BatchPrio3:
                 ping_pong.PingPongMessage.TYPE_FINISH, prep_msg=prep_msg
             )
             out.append(PreparedReport(
-                "finished", outbound=outbound, out_share_raw=out_share_d[i],
+                "finished", outbound=outbound,
+                out_share_raw=LaneRef(out_share_d, i),
                 device_shares=out_share_d, lane=i,
             ))
         return out
@@ -574,12 +600,14 @@ class BatchPrio3:
             # PrepState.out_share carries raw limbs here (not Python ints):
             # prep_next passes it through untouched, and both leader_finish
             # and aggregate() consume the raw form directly.
-            state = ping_pong.PingPongContinued(PrepState(out_share_d[i], jr_seed), 0)
+            state = ping_pong.PingPongContinued(
+                PrepState(LaneRef(out_share_d, i), jr_seed), 0)
             outbound = ping_pong.PingPongMessage(
                 ping_pong.PingPongMessage.TYPE_INITIALIZE, prep_share=prep_share
             )
             out.append(PreparedReport(
-                "continued", outbound=outbound, out_share_raw=out_share_d[i],
+                "continued", outbound=outbound,
+                out_share_raw=LaneRef(out_share_d, i),
                 prep_share=prep_share, state=state,
                 device_shares=out_share_d, lane=i,
             ))
@@ -659,10 +687,11 @@ class BatchPrio3:
         """Device tree-sum of raw output-share rows -> aggregate share ints."""
         if not rows:
             return self.vdaf.aggregate_init()
+        rows = [np.asarray(r) for r in rows]
         K = len(rows)
         M = self._bucket(K)
         arr = np.zeros((M,) + tuple(rows[0].shape), dtype=np.uint32)
-        arr[:K] = np.stack([np.asarray(r) for r in rows])
+        arr[:K] = np.stack(rows)
         mask = np.zeros(M, dtype=bool)
         mask[:K] = True
         return self.aggregate_masked(arr, mask)

@@ -20,6 +20,15 @@ class HostPrepEngine:
         self.vdaf = vdaf
         self.fallback_count = 0
 
+    def bind(self, agg_param: bytes) -> "HostPrepEngine":
+        """Bind an aggregation parameter (Poplar1); no-op for param-free
+        VDAFs with an empty param."""
+        if hasattr(self.vdaf, "with_agg_param"):
+            return HostPrepEngine(self.vdaf.with_agg_param(agg_param))
+        if agg_param:
+            raise VdafError("unexpected aggregation parameter")
+        return self
+
     def _out_share_arr(self, out_share) -> np.ndarray:
         return np.asarray([[v & 0xFFFFFFFF, v >> 32] for v in out_share],
                           dtype=np.uint64).astype(np.uint32)
@@ -40,10 +49,18 @@ class HostPrepEngine:
                     self.vdaf, verify_key, nonce, pub, share, inbound
                 )
                 state, outbound = transition.evaluate()
-                out.append(PreparedReport(
-                    "finished", outbound=outbound,
-                    out_share_raw=self._out_share_arr(state.out_share),
-                ))
+                if state.finished:
+                    out.append(PreparedReport(
+                        "finished", outbound=outbound,
+                        out_share_raw=state.out_share,
+                    ))
+                else:
+                    # multi-round VDAF: persist our state, await the leader
+                    out.append(PreparedReport(
+                        "continued", outbound=outbound, state=state,
+                        prep_share=self.vdaf.encode_prep_state(
+                            state.prep_state, state.current_round),
+                    ))
             except (VdafError, ValueError, AssertionError, NotImplementedError) as e:
                 out.append(PreparedReport("failed", error=str(e)))
         return out
@@ -60,7 +77,7 @@ class HostPrepEngine:
                 )
                 out.append(PreparedReport(
                     "continued", outbound=outbound, state=state,
-                    out_share_raw=self._out_share_arr(state.prep_state.out_share),
+                    out_share_raw=state.prep_state.out_share,
                     prep_share=outbound.prep_share,
                 ))
             except (VdafError, ValueError, AssertionError, NotImplementedError) as e:
@@ -74,10 +91,17 @@ class HostPrepEngine:
                 out.append(rep)
                 continue
             try:
-                finished = ping_pong.leader_continued(self.vdaf, rep.state, msg)
+                res = ping_pong.continued(self.vdaf, rep.state, msg)
+                if getattr(res, "finished", False):
+                    out.append(PreparedReport(
+                        "finished", out_share_raw=res.out_share))
+                    continue
+                # Multi-round: the transition must be PERSISTED before the
+                # next exchange so a crashed/timed-out leader can resume
+                # idempotently (reference WaitingLeader{transition}).
                 out.append(PreparedReport(
-                    "finished", out_share_raw=self._out_share_arr(finished.out_share)
-                ))
+                    "waiting", state=res,
+                    prep_share=self.vdaf.encode_transition(res)))
             except (VdafError, NotImplementedError) as e:
                 out.append(PreparedReport("failed", error=str(e)))
         return out
@@ -91,5 +115,6 @@ class HostPrepEngine:
     def aggregate_raw_rows(self, rows) -> list:
         agg = self.vdaf.aggregate_init()
         for raw in rows:
-            agg = self.vdaf.aggregate_update(agg, self._raw_to_ints(raw))
+            ints = raw if isinstance(raw, list) else self._raw_to_ints(raw)
+            agg = self.vdaf.aggregate_update(agg, ints)
         return agg

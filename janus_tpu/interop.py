@@ -63,6 +63,8 @@ def vdaf_from_json(obj: dict) -> VdafInstance:
     if kind == "Prio3Histogram":
         return VdafInstance.prio3_histogram(
             _num(obj["length"]), _num(obj["chunk_length"]))
+    if kind == "Poplar1":
+        return VdafInstance.poplar1(_num(obj["bits"]))
     if kind == "Prio3FixedPointBoundedL2VecSum":
         bitsize = _num(obj.get("bitsize", 16))
         length = _num(obj["length"])
@@ -75,7 +77,7 @@ def vdaf_from_json(obj: dict) -> VdafInstance:
 
 def parse_measurement(vdaf: VdafInstance, measurement):
     """Interop measurements arrive as strings / lists of strings."""
-    if vdaf.kind in ("Prio3Count", "Prio3Sum", "Prio3Histogram"):
+    if vdaf.kind in ("Prio3Count", "Prio3Sum", "Prio3Histogram", "Poplar1"):
         return _num(measurement)
     if vdaf.kind == "Prio3FixedPointBoundedL2VecSum":
         return [float(x) for x in measurement]

@@ -906,11 +906,42 @@ class AggregationJobInitializeReq(WireMessage):
 
     @classmethod
     def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
-        return cls(
-            cur.opaque32(),
-            PartialBatchSelector.decode_expecting(cur, expect),
-            tuple(decode_vec32(cur, PrepareInit.decode_from)),
-        )
+        agg_param = cur.opaque32()
+        pbs = PartialBatchSelector.decode_expecting(cur, expect)
+        inits = cls._decode_inits_native(cur)
+        if inits is None:
+            inits = tuple(decode_vec32(cur, PrepareInit.decode_from))
+        return cls(agg_param, pbs, inits)
+
+    @classmethod
+    def _decode_inits_native(cls, cur: Cursor):
+        """Fast path: one C++ pass over the PrepareInit vector emits an
+        offset table (janus_tpu.native); falls back to the Python codec when
+        the native library is unavailable."""
+        from janus_tpu import native
+
+        if not native.available():
+            return None
+        body = cur.opaque32()
+        table = native.parse_prepare_inits(body)
+        if table is None:
+            raise DecodeError("malformed PrepareInit vector")
+        out = []
+        for row in table.tolist():
+            (id_off, time_s, pub_off, pub_len, config_id, enc_off, enc_len,
+             ct_off, ct_len, msg_off, msg_len) = row
+            out.append(PrepareInit(
+                ReportShare(
+                    ReportMetadata(ReportId(body[id_off : id_off + 16]),
+                                   Time(time_s)),
+                    body[pub_off : pub_off + pub_len],
+                    HpkeCiphertext(HpkeConfigId(config_id),
+                                   body[enc_off : enc_off + enc_len],
+                                   body[ct_off : ct_off + ct_len]),
+                ),
+                body[msg_off : msg_off + msg_len],
+            ))
+        return tuple(out)
 
     decode_from = decode_expecting
 

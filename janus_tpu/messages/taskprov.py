@@ -205,6 +205,8 @@ class VdafType(WireMessage):
                 self.proofs, self.bits, self.length, self.chunk_length)
         if self.code == self.PRIO3_HISTOGRAM:
             return VdafInstance.prio3_histogram(self.length, self.chunk_length)
+        if self.code == self.POPLAR1:
+            return VdafInstance.poplar1(self.bits)
         raise ValueError(f"unsupported taskprov VDAF {self.code:#x}")
 
 

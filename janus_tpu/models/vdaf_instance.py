@@ -3,7 +3,7 @@
 Mirrors the reference's enum (core/src/vdaf.rs:65-108): Prio3Count,
 Prio3Sum{bits}, Prio3SumVec{bits,length,chunk_length},
 Prio3SumVecField64MultiproofHmacSha256Aes128{proofs,bits,length,chunk_length},
-Prio3Histogram{length,chunk_length}, Poplar1{bits} (not yet implemented),
+Prio3Histogram{length,chunk_length}, Poplar1{bits},
 plus the test-only Fake / FakeFailsPrepInit / FakeFailsPrepStep.
 
 The serde form matches Rust's externally-tagged enum encoding so task configs
@@ -86,6 +86,10 @@ class VdafInstance:
         return cls("Prio3Histogram", (("length", length), ("chunk_length", chunk_length)))
 
     @classmethod
+    def poplar1(cls, bits: int) -> "VdafInstance":
+        return cls("Poplar1", (("bits", bits),))
+
+    @classmethod
     def prio3_fixedpoint_boundedl2_vec_sum(cls, bitsize: int, length: int,
                                            chunk_length: int) -> "VdafInstance":
         return cls("Prio3FixedPointBoundedL2VecSum",
@@ -159,6 +163,10 @@ def vdaf_for_instance(inst: VdafInstance):
     if k == "Prio3FixedPointBoundedL2VecSum":
         return _prio3.new_fixedpoint_boundedl2_vec_sum(
             inst.length, inst.bitsize, inst.chunk_length)
+    if k == "Poplar1":
+        from janus_tpu.vdaf.poplar1 import new_poplar1
+
+        return new_poplar1(inst.bits)
     if k == "Fake":
         if inst.rounds != 1:
             raise NotImplementedError("DummyVdaf supports exactly 1 round")
@@ -183,14 +191,16 @@ def prep_engine(inst: VdafInstance):
         engine = _engines.get(inst)
         if engine is None:
             vdaf = vdaf_for_instance(inst)
-            if isinstance(vdaf, DummyVdaf):
-                from janus_tpu.engine.host import HostPrepEngine
-
-                engine = HostPrepEngine(vdaf)
-            else:
+            if isinstance(vdaf, _prio3.Prio3):
                 from janus_tpu.engine import BatchPrio3
 
                 engine = BatchPrio3(vdaf)
+            else:
+                # Fake* and Poplar1 run the per-report oracle on the host
+                # (Poplar1 IDPF device kernels are future work).
+                from janus_tpu.engine.host import HostPrepEngine
+
+                engine = HostPrepEngine(vdaf)
             _engines[inst] = engine
         return engine
 

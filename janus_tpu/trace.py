@@ -1,0 +1,124 @@
+"""Structured tracing (reference aggregator/src/trace.rs:119,
+docs/CONFIGURING_TRACING.md): span-scoped timing with human or JSON output
+and env-based filtering.
+
+    install_trace_subscriber(TraceConfiguration(...))   # or JANUS_LOG=debug
+    with span("VDAF preparation", task_id=..., reports=N):
+        ...
+
+Hot sections are spanned the way the reference spans them
+(`trace_span!("VDAF preparation")` — aggregator.rs:1946): spans record wall
+time and emit at debug level; events emit at their own level.  The
+subscriber is process-global and thread-safe; spans nest via thread-local
+context so output shows the active span path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json as _json
+import os
+import sys
+import threading
+import time as _time
+from dataclasses import dataclass
+
+_LEVELS = {"error": 0, "warn": 1, "info": 2, "debug": 3, "trace": 4}
+
+
+@dataclass
+class TraceConfiguration:
+    """reference trace.rs:36."""
+
+    level: str = "info"  # default filter; JANUS_LOG env overrides
+    use_json: bool = False
+    stream: object = None  # defaults to stderr
+
+
+class _Subscriber:
+    def __init__(self, cfg: TraceConfiguration):
+        self.cfg = cfg
+        env = os.environ.get("JANUS_LOG")
+        self.level = _LEVELS.get((env or cfg.level).lower(), 2)
+        self.stream = cfg.stream or sys.stderr
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _path(self) -> list[str]:
+        if not hasattr(self._local, "spans"):
+            self._local.spans = []
+        return self._local.spans
+
+    def emit(self, level: str, message: str, **fields) -> None:
+        if _LEVELS[level] > self.level:
+            return
+        spans = ":".join(self._path())
+        if self.cfg.use_json:
+            record = {"ts": _time.time(), "level": level, "message": message,
+                      "spans": spans, **fields}
+            line = _json.dumps(record)
+        else:
+            extras = " ".join(f"{k}={v}" for k, v in fields.items())
+            prefix = f"[{spans}] " if spans else ""
+            line = f"{level.upper():5} {prefix}{message} {extras}".rstrip()
+        with self._lock:
+            print(line, file=self.stream, flush=True)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        path = self._path()
+        path.append(name)
+        t0 = _time.monotonic()
+        try:
+            yield
+        finally:
+            dt = _time.monotonic() - t0
+            # emit inside the span so the path includes it, then unwind
+            self.emit("debug", f"{name} done", duration_ms=round(1e3 * dt, 2),
+                      **fields)
+            path.pop()
+
+
+_subscriber: _Subscriber | None = None
+_install_lock = threading.Lock()
+
+
+def install_trace_subscriber(cfg: TraceConfiguration | None = None) -> _Subscriber:
+    """Install (or replace) the process-global subscriber
+    (reference trace.rs:119 install_trace_subscriber)."""
+    global _subscriber
+    with _install_lock:
+        _subscriber = _Subscriber(cfg or TraceConfiguration())
+        return _subscriber
+
+
+def _get() -> _Subscriber:
+    global _subscriber
+    if _subscriber is None:
+        install_trace_subscriber()
+    return _subscriber
+
+
+def span(name: str, **fields):
+    """Context manager timing a section under the active span path."""
+    return _get().span(name, **fields)
+
+
+def event(level: str, message: str, **fields) -> None:
+    _get().emit(level, message, **fields)
+
+
+def debug(message: str, **fields) -> None:
+    event("debug", message, **fields)
+
+
+def info(message: str, **fields) -> None:
+    event("info", message, **fields)
+
+
+def warn(message: str, **fields) -> None:
+    event("warn", message, **fields)
+
+
+def error(message: str, **fields) -> None:
+    event("error", message, **fields)

@@ -1,0 +1,223 @@
+"""Incremental Distributed Point Function (IDPF) — Python oracle.
+
+The 2-party IDPF underlying Poplar1 (VDAF-08 §8 structure; the reference
+consumes prio's `idpf` module via Poplar1 — core/src/vdaf.rs:95): Gen
+produces two keys that, evaluated at any prefix of the programmed point
+`alpha`, share the programmed beta value for that level, and share zero at
+every other prefix.  Inner levels carry Field64 pairs, the leaf level
+Field255 pairs (value, authenticator).
+
+The PRG is AES-128 with a fixed key acting as an extend/convert function
+(cheap per-node expansion; the fixed key is derived once per (nonce, dst)).
+Correctness property (pinned in tests/test_idpf.py): for every level L and
+candidate prefix p,  Eval(key0, p) + Eval(key1, p) == beta_L if p is a
+prefix of alpha else 0.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from janus_tpu.vdaf.field_ref import Field, Field64
+
+
+class Field255(Field):
+    """GF(2^255 - 19) for IDPF leaves (large enough that random non-zero
+    shares never collide)."""
+
+    MODULUS = (1 << 255) - 19
+    ENCODED_SIZE = 32
+    # generator metadata unused by the IDPF (no NTT at the leaves)
+    GENERATOR = 2
+    GEN_ORDER = MODULUS - 1
+
+
+KEY_SIZE = 16
+RAND_SIZE = 2 * KEY_SIZE
+
+
+def _fixed_key(nonce: bytes, dst: bytes) -> bytes:
+    return hashlib.sha256(b"idpf fixed key" + bytes([len(dst)]) + dst
+                          + nonce).digest()[:16]
+
+
+class _Prg:
+    """Fixed-key AES-based node expansion."""
+
+    def __init__(self, nonce: bytes, dst: bytes):
+        self._key = _fixed_key(nonce, dst)
+
+    def _block(self, seed: bytes, label: bytes) -> bytes:
+        # CTR over a seed-derived IV: 2 blocks per call
+        iv = hashlib.sha256(seed + label).digest()[:16]
+        enc = Cipher(algorithms.AES(self._key), modes.CTR(iv)).encryptor()
+        return enc.update(bytes(32))
+
+    def extend(self, seed: bytes) -> tuple[bytes, int, bytes, int]:
+        """seed -> (seed_left, ctrl_left, seed_right, ctrl_right)."""
+        out_l = self._block(seed, b"L")
+        out_r = self._block(seed, b"R")
+        return out_l[:16], out_l[16] & 1, out_r[:16], out_r[16] & 1
+
+    def convert(self, seed: bytes, field: type[Field], n: int,
+                level: int) -> tuple[bytes, list[int]]:
+        """seed -> (next seed, n field elements)."""
+        stream = self._block(seed, b"C" + level.to_bytes(2, "big"))
+        next_seed = stream[:16]
+        out = []
+        counter = 0
+        buf = b""
+        while len(out) < n:
+            if len(buf) < field.ENCODED_SIZE:
+                iv = hashlib.sha256(seed + b"V" + level.to_bytes(2, "big")
+                                    + counter.to_bytes(4, "big")).digest()[:16]
+                enc = Cipher(algorithms.AES(self._key),
+                             modes.CTR(iv)).encryptor()
+                buf += enc.update(bytes(64))
+                counter += 1
+            x = int.from_bytes(buf[: field.ENCODED_SIZE], "little")
+            buf = buf[field.ENCODED_SIZE:]
+            x &= (1 << (8 * field.ENCODED_SIZE - 1)) - 1  # clear top bit
+            if x < field.MODULUS:
+                out.append(x)
+        return next_seed, out
+
+
+class IdpfKey:
+    def __init__(self, party: int, seed: bytes, seed_cws: list,
+                 payload_cws: list):
+        self.party = party
+        self.seed = seed
+        self.seed_cws = seed_cws  # per level: (cw_seed, cw_ctrl_l, cw_ctrl_r)
+        self.payload_cws = payload_cws  # per level: list of field ints
+
+    def encode(self) -> bytes:
+        out = bytearray([self.party])
+        out += self.seed
+        for (cs, cl, cr), pcw in zip(self.seed_cws, self.payload_cws):
+            out += cs + bytes([cl | (cr << 1)])
+        for level, pcw in enumerate(self.payload_cws):
+            field = Field255 if level == len(self.payload_cws) - 1 else Field64
+            for v in pcw:
+                out += v.to_bytes(field.ENCODED_SIZE, "little")
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes, bits: int, value_len: int) -> "IdpfKey":
+        party = data[0]
+        off = 1
+        seed = data[off : off + KEY_SIZE]
+        off += KEY_SIZE
+        seed_cws = []
+        for _ in range(bits):
+            cs = data[off : off + KEY_SIZE]
+            off += KEY_SIZE
+            ctrl = data[off]
+            off += 1
+            seed_cws.append((cs, ctrl & 1, (ctrl >> 1) & 1))
+        payload_cws = []
+        for level in range(bits):
+            field = Field255 if level == bits - 1 else Field64
+            row = []
+            for _ in range(value_len):
+                row.append(int.from_bytes(data[off : off + field.ENCODED_SIZE],
+                                          "little"))
+                off += field.ENCODED_SIZE
+            payload_cws.append(row)
+        if off != len(data):
+            raise ValueError("trailing bytes in IDPF key")
+        return cls(party, seed, seed_cws, payload_cws)
+
+
+class Idpf:
+    """2-party IDPF over `bits`-bit inputs with VALUE_LEN elements/level."""
+
+    def __init__(self, bits: int, value_len: int, nonce: bytes,
+                 dst: bytes = b"janus-tpu idpf"):
+        self.bits = bits
+        self.value_len = value_len
+        self.prg = _Prg(nonce, dst)
+
+    def _field(self, level: int) -> type[Field]:
+        return Field255 if level == self.bits - 1 else Field64
+
+    def gen(self, alpha: int, betas: list[list[int]],
+            rand: bytes | None = None) -> tuple[IdpfKey, IdpfKey]:
+        """Program point alpha with per-level payloads `betas`."""
+        assert 0 <= alpha < (1 << self.bits)
+        assert len(betas) == self.bits
+        rand = os.urandom(RAND_SIZE) if rand is None else rand
+        seeds = [rand[:KEY_SIZE], rand[KEY_SIZE:]]
+        ctrls = [0, 1]
+        seed_cws = []
+        payload_cws = []
+        for level in range(self.bits):
+            f = self._field(level)
+            bit = (alpha >> (self.bits - 1 - level)) & 1
+            ext = [self.prg.extend(seeds[0]), self.prg.extend(seeds[1])]
+            # (seed_l, ctrl_l, seed_r, ctrl_r) per party
+            keep, lose = (2, 0) if bit else (0, 2)
+            cw_seed = bytes(a ^ b for a, b in zip(ext[0][lose], ext[1][lose]))
+            cw_ctrl_l = ext[0][1] ^ ext[1][1] ^ bit ^ 1
+            cw_ctrl_r = ext[0][3] ^ ext[1][3] ^ bit
+            cw_ctrl_keep = cw_ctrl_r if bit else cw_ctrl_l
+            seed_cws.append((cw_seed, cw_ctrl_l, cw_ctrl_r))
+            new_seeds, new_ctrls = [], []
+            for p in (0, 1):
+                s = ext[p][keep]
+                t = ext[p][keep + 1]
+                if ctrls[p]:
+                    s = bytes(a ^ b for a, b in zip(s, cw_seed))
+                    t ^= cw_ctrl_keep
+                new_seeds.append(s)
+                new_ctrls.append(t)
+            # convert to field payloads
+            conv = [self.prg.convert(new_seeds[p], f, self.value_len, level)
+                    for p in (0, 1)]
+            w = [conv[p][1] for p in (0, 1)]
+            next_seeds = [conv[p][0] for p in (0, 1)]
+            beta = betas[level]
+            assert len(beta) == self.value_len
+            # cw so that (w0 + cw*(t0 applies)) - (w1 + cw*(t1 applies)) == beta
+            # exactly one party applies the payload cw (ctrl bits differ on path)
+            sign = -1 if new_ctrls[1] else 1
+            cw = [f.mul(sign % f.MODULUS,
+                        f.sub(f.sub(beta[i], w[0][i]), f.neg(w[1][i])))
+                  for i in range(self.value_len)]
+            payload_cws.append(cw)
+            seeds = next_seeds
+            ctrls = new_ctrls
+        key0 = IdpfKey(0, rand[:KEY_SIZE], seed_cws, payload_cws)
+        key1 = IdpfKey(1, rand[KEY_SIZE:], seed_cws, payload_cws)
+        return key0, key1
+
+    def eval_prefix(self, key: IdpfKey, level: int, prefix: int) -> list[int]:
+        """Evaluate one (level, prefix) -> VALUE_LEN field-element shares."""
+        assert 0 <= level < self.bits
+        assert 0 <= prefix < (1 << (level + 1))
+        seed = key.seed
+        ctrl = key.party
+        for lv in range(level + 1):
+            f = self._field(lv)
+            bit = (prefix >> (level - lv)) & 1
+            s_l, t_l, s_r, t_r = self.prg.extend(seed)
+            s, t = (s_r, t_r) if bit else (s_l, t_l)
+            cw_seed, cw_ctrl_l, cw_ctrl_r = key.seed_cws[lv]
+            if ctrl:
+                s = bytes(a ^ b for a, b in zip(s, cw_seed))
+                t ^= cw_ctrl_r if bit else cw_ctrl_l
+            seed, w = self.prg.convert(s, self._field(lv), self.value_len, lv)
+            ctrl = t
+        out = list(w)
+        if ctrl:
+            cw = key.payload_cws[level]
+            out = [f.add(v, c) for v, c in zip(out, cw)]
+        if key.party == 1:
+            out = [f.neg(v) for v in out]
+        return out
+
+    def eval(self, key: IdpfKey, level: int, prefixes: list[int]) -> list[list[int]]:
+        return [self.eval_prefix(key, level, p) for p in prefixes]

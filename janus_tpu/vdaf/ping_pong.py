@@ -117,7 +117,17 @@ class PingPongTransition:
                 PingPongFinished(out_share),
                 PingPongMessage(PingPongMessage.TYPE_FINISH, prep_msg=self.prep_msg_bytes),
             )
-        raise NotImplementedError("multi-round VDAFs not yet supported")
+        # Multi-round: advance our state and send (prep message, next prep
+        # share) in one CONTINUE message.
+        next_state, next_share = self.vdaf.prep_next(self.prep_state, msg)
+        return (
+            PingPongContinued(next_state, self.current_round + 1),
+            PingPongMessage(
+                PingPongMessage.TYPE_CONTINUE,
+                prep_msg=self.prep_msg_bytes,
+                prep_share=self.vdaf.encode_prep_share(next_share),
+            ),
+        )
 
 
 def leader_initialized(
@@ -156,11 +166,32 @@ def helper_initialized(
 
 def leader_continued(
     vdaf: Prio3, state: PingPongContinued, inbound: PingPongMessage
-) -> PingPongFinished:
-    """Leader consumes the helper's finish message; raises on mismatch."""
+):
+    """Leader consumes the helper's message.
+
+    FINISH at the final round -> PingPongFinished.
+    CONTINUE mid-protocol -> PingPongTransition: the leader advances with the
+    peer's prep message, combines the next round's prep shares, and its
+    evaluate() yields (state', outbound) for the next exchange.
+    """
     if inbound.type == PingPongMessage.TYPE_FINISH:
         if state.current_round + 1 != vdaf.ROUNDS:
             raise VdafError("peer finished early")
         msg = vdaf.decode_prep_message(inbound.prep_msg)
         return PingPongFinished(vdaf.prep_next(state.prep_state, msg))
-    raise NotImplementedError("multi-round VDAFs not yet supported")
+    if inbound.type == PingPongMessage.TYPE_CONTINUE:
+        if state.current_round + 1 >= vdaf.ROUNDS:
+            raise VdafError("peer continued past the final round")
+        msg = vdaf.decode_prep_message(inbound.prep_msg)
+        next_state, own_share = vdaf.prep_next(state.prep_state, msg)
+        peer_share = vdaf.decode_prep_share(inbound.prep_share)
+        prep_msg = vdaf.prep_shares_to_prep([own_share, peer_share])
+        return PingPongTransition(
+            vdaf, next_state, vdaf.encode_prep_message(prep_msg),
+            state.current_round + 1)
+    raise VdafError("unexpected ping-pong message type")
+
+
+# The continuation logic is role-agnostic (both sides hold a Continued state
+# and consume the peer's message); `continued` is the generic name.
+continued = leader_continued

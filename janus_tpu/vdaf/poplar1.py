@@ -1,0 +1,346 @@
+"""Poplar1 — heavy-hitters VDAF over the IDPF (Python oracle).
+
+The reference exposes Poplar1{bits} (core/src/vdaf.rs:95, consumed from
+prio's poplar1 module).  This implementation follows the Poplar construction
+(BBCG+21): the client programs an IDPF at its input string alpha; for an
+aggregation parameter (level, prefixes) each aggregator evaluates its key
+share over the candidate prefixes and the pair runs a two-round sketch that
+proves the share vector sums to a unit vector — without learning which
+prefix — using client-supplied multiplication-correlated randomness
+(a, b=a^2, c) and aggregator-secret query randomness r_i derived from the
+verify key (unpredictable to the client, which is what soundness needs).
+
+Round 1 exchanges masked sketch shares (z + a, z* + c, zc); round 2
+exchanges shares of  z^2 - z*  linearized through the public masked values:
+    z^2 - z* = Z'^2 - 2 Z' a + b - Zs' + c          (b = a^2)
+which is affine in the client's correlated randomness, so each aggregator
+computes its share locally.  Accept iff the combined value is 0 and the
+public count zc is 1.
+
+Agg param wire format: u16 level || u32 count || count * u64 prefixes.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from janus_tpu.vdaf.idpf import RAND_SIZE as IDPF_RAND_SIZE
+from janus_tpu.vdaf.idpf import Field255, Idpf, IdpfKey
+from janus_tpu.vdaf.field_ref import Field64
+from janus_tpu.vdaf.prio3 import PrepMessage, PrepShare, PrepState, VdafError
+from janus_tpu.vdaf.xof import XofTurboShake128
+
+ALGO_POPLAR1 = 0x00001000
+
+
+def encode_agg_param(level: int, prefixes: list[int]) -> bytes:
+    out = struct.pack(">HI", level, len(prefixes))
+    for p in prefixes:
+        out += struct.pack(">Q", p)
+    return out
+
+
+def decode_agg_param(data: bytes) -> tuple[int, list[int]]:
+    if len(data) < 6:
+        raise VdafError("short Poplar1 agg param")
+    level, count = struct.unpack(">HI", data[:6])
+    want = 6 + 8 * count
+    if len(data) != want:
+        raise VdafError("bad Poplar1 agg param length")
+    prefixes = [struct.unpack(">Q", data[6 + 8 * i : 14 + 8 * i])[0]
+                for i in range(count)]
+    if sorted(set(prefixes)) != sorted(prefixes):
+        raise VdafError("duplicate prefixes")
+    return level, prefixes
+
+
+class Poplar1:
+    ROUNDS = 2
+    shares = 2
+    SEED_SIZE = 16
+    VERIFY_KEY_SIZE = 16
+
+    def __init__(self, bits: int):
+        assert 0 < bits <= 64
+        self.bits = bits
+        self.RAND_SIZE = IDPF_RAND_SIZE + 2 * self.SEED_SIZE
+        self.has_joint_rand = False
+        self.xof = XofTurboShake128
+        self._agg_param: tuple[int, list[int]] | None = None
+
+    # -- aggregation-parameter binding ------------------------------------
+
+    def with_agg_param(self, data: bytes) -> "Poplar1":
+        bound = Poplar1(self.bits)
+        bound._agg_param = decode_agg_param(data)
+        level, prefixes = bound._agg_param
+        if not (0 <= level < self.bits):
+            raise VdafError("level out of range")
+        if any(p >= (1 << (level + 1)) for p in prefixes):
+            raise VdafError("prefix out of range for level")
+        return bound
+
+    def _bound(self) -> tuple[int, list[int]]:
+        if self._agg_param is None:
+            raise VdafError("Poplar1 requires an aggregation parameter")
+        return self._agg_param
+
+    def _field(self, level: int):
+        return Field255 if level == self.bits - 1 else Field64
+
+    def _idpf(self, nonce: bytes) -> Idpf:
+        return Idpf(self.bits, 1, nonce)
+
+    def _corr(self, seed: bytes, level: int, field):
+        """Party-local correlated-randomness share from its seed."""
+        return self.xof.expand_into_vec(
+            field, seed, b"poplar1 corr", level.to_bytes(2, "big"), 3)
+
+    # -- client ------------------------------------------------------------
+
+    def shard(self, measurement: int, nonce: bytes, rand: bytes):
+        assert 0 <= measurement < (1 << self.bits)
+        assert len(rand) == self.RAND_SIZE
+        idpf_rand = rand[:IDPF_RAND_SIZE]
+        corr_seeds = [rand[IDPF_RAND_SIZE : IDPF_RAND_SIZE + 16],
+                      rand[IDPF_RAND_SIZE + 16 :]]
+        betas = [[1] for _ in range(self.bits)]
+        key0, key1 = self._idpf(nonce).gen(measurement, betas, idpf_rand)
+        # correlated randomness: per level, a random, b = a^2, c random;
+        # party shares come from the seeds, the leader carries offsets.
+        offsets: list[list[int]] = []
+        for level in range(self.bits):
+            f = self._field(level)
+            s0 = self._corr(corr_seeds[0], level, f)
+            s1 = self._corr(corr_seeds[1], level, f)
+            a = f.add(s0[0], s1[0])  # a defined by the seeds
+            b = f.mul(a, a)
+            # offsets fix up b (and leave c as the seeds produced)
+            offsets.append([0, f.sub(b, f.add(s0[1], s1[1])), 0])
+        return b"", [
+            (key0, corr_seeds[0], offsets),
+            (key1, corr_seeds[1], None),
+        ]
+
+    # -- preparation (2 rounds) --------------------------------------------
+
+    def prep_init(self, verify_key: bytes, agg_id: int, nonce: bytes,
+                  public_share, input_share):
+        level, prefixes = self._bound()
+        f = self._field(level)
+        key, corr_seed, offsets = input_share
+        ys = [v[0] for v in self._idpf(nonce).eval(key, level, prefixes)]
+        # query randomness: secret from the client (verify key)
+        rs = self.xof.expand_into_vec(
+            f, verify_key, b"poplar1 query",
+            nonce + level.to_bytes(2, "big") + len(prefixes).to_bytes(4, "big"),
+            len(prefixes))
+        z = zc = zs = 0
+        for r, y in zip(rs, ys):
+            z = f.add(z, f.mul(r, y))
+            zs = f.add(zs, f.mul(f.mul(r, r), y))
+            zc = f.add(zc, y)
+        a_s, b_s, c_s = self._corr(corr_seed, level, f)
+        if offsets is not None:
+            off = offsets[level]
+            a_s = f.add(a_s, off[0])
+            b_s = f.add(b_s, off[1])
+            c_s = f.add(c_s, off[2])
+        # round-1 sketch share: (z + a, z* + c, zc)
+        r1 = [f.add(z, a_s), f.add(zs, c_s), zc]
+        state = PrepState(ys, None)
+        state.poplar = (agg_id, level, a_s, b_s, c_s)
+        return state, PrepShare(None, r1)
+
+    def prep_shares_to_prep(self, prep_shares: list[PrepShare]):
+        level, _ = self._bound()
+        f = self._field(level)
+        if len(prep_shares) != 2:
+            raise VdafError("Poplar1 is 2-party")
+        combined = [
+            f.add(x, y) for x, y in zip(prep_shares[0].verifiers,
+                                        prep_shares[1].verifiers)
+        ]
+        if len(combined) == 3:
+            # round 1 -> broadcast (Z', Zs', ZC)
+            if combined[2] != 1:
+                raise VdafError("Poplar1 count check failed")
+            return PrepMessage(None, payload=combined)
+        # round 2 -> sigma must combine to zero
+        if combined != [0]:
+            raise VdafError("Poplar1 sketch verification failed")
+        return PrepMessage(None, payload=[])
+
+    def prep_next(self, state: PrepState, msg: PrepMessage):
+        level, _ = self._bound()
+        f = self._field(level)
+        agg_id, _level, a_s, b_s, c_s = state.poplar
+        if msg.payload == []:
+            # final round: verified; emit the output share
+            return state.out_share
+        zp, zsp, _zc = msg.payload  # public (Z', Zs', ZC)
+        #  z^2 - z* = Z'^2 - 2 Z' a + b - Zs' + c, shared affinely:
+        sigma = f.sub(f.add(b_s, c_s), f.mul(f.add(zp, zp), a_s))
+        if agg_id == 0:
+            sigma = f.add(sigma, f.sub(f.mul(zp, zp), zsp))
+        nxt = PrepState(state.out_share, None)
+        nxt.poplar = state.poplar
+        return nxt, PrepShare(None, [sigma])
+
+    # -- aggregation -------------------------------------------------------
+
+    def aggregate_init(self):
+        level, prefixes = self._bound()
+        return [0] * len(prefixes)
+
+    def aggregate_update(self, agg_share, out_share):
+        level, _ = self._bound()
+        f = self._field(level)
+        return [f.add(x, y) for x, y in zip(agg_share, out_share)]
+
+    def unshard(self, agg_shares, num_measurements: int):
+        level, prefixes = self._bound()
+        f = self._field(level)
+        total = self.aggregate_init()
+        for s in agg_shares:
+            total = self.aggregate_update(total, s)
+        return total  # per-prefix counts
+
+    # -- codecs ------------------------------------------------------------
+
+    def encode_public_share(self, public_share) -> bytes:
+        return b""
+
+    def decode_public_share(self, data: bytes):
+        if data:
+            raise VdafError("unexpected Poplar1 public share bytes")
+        return b""
+
+    def encode_input_share(self, agg_id: int, input_share) -> bytes:
+        key, corr_seed, offsets = input_share
+        out = bytearray(corr_seed)
+        if agg_id == 0:
+            for level, off in enumerate(offsets):
+                f = self._field_static(level)
+                for v in off:
+                    out += v.to_bytes(f.ENCODED_SIZE, "little")
+        out += key.encode()
+        return bytes(out)
+
+    def _field_static(self, level: int):
+        return Field255 if level == self.bits - 1 else Field64
+
+    def decode_input_share(self, agg_id: int, data: bytes):
+        corr_seed = data[:16]
+        off = 16
+        offsets = None
+        if agg_id == 0:
+            offsets = []
+            for level in range(self.bits):
+                f = self._field_static(level)
+                row = []
+                for _ in range(3):
+                    row.append(int.from_bytes(
+                        data[off : off + f.ENCODED_SIZE], "little"))
+                    off += f.ENCODED_SIZE
+                offsets.append(row)
+        key = IdpfKey.decode(data[off:], self.bits, 1)
+        return (key, corr_seed, offsets)
+
+    def encode_prep_share(self, ps: PrepShare) -> bytes:
+        level, _ = self._bound()
+        f = self._field(level)
+        return b"".join(v.to_bytes(f.ENCODED_SIZE, "little")
+                        for v in ps.verifiers)
+
+    def decode_prep_share(self, data: bytes) -> PrepShare:
+        level, _ = self._bound()
+        f = self._field(level)
+        if len(data) % f.ENCODED_SIZE or len(data) // f.ENCODED_SIZE not in (1, 3):
+            raise VdafError("bad Poplar1 prep share length")
+        n = len(data) // f.ENCODED_SIZE
+        return PrepShare(None, [
+            int.from_bytes(data[i * f.ENCODED_SIZE : (i + 1) * f.ENCODED_SIZE],
+                           "little")
+            for i in range(n)
+        ])
+
+    def encode_prep_message(self, msg: PrepMessage) -> bytes:
+        level, _ = self._bound()
+        f = self._field(level)
+        return b"".join(v.to_bytes(f.ENCODED_SIZE, "little")
+                        for v in msg.payload)
+
+    def decode_prep_message(self, data: bytes) -> PrepMessage:
+        level, _ = self._bound()
+        f = self._field(level)
+        if len(data) % f.ENCODED_SIZE or len(data) // f.ENCODED_SIZE not in (0, 3):
+            raise VdafError("bad Poplar1 prep message length")
+        n = len(data) // f.ENCODED_SIZE
+        return PrepMessage(None, payload=[
+            int.from_bytes(data[i * f.ENCODED_SIZE : (i + 1) * f.ENCODED_SIZE],
+                           "little")
+            for i in range(n)
+        ])
+
+    def encode_out_share(self, out_share) -> bytes:
+        level, _ = self._bound()
+        f = self._field(level)
+        return b"".join(v.to_bytes(f.ENCODED_SIZE, "little") for v in out_share)
+
+    def decode_out_share(self, data: bytes):
+        level, prefixes = self._bound()
+        f = self._field(level)
+        return [int.from_bytes(data[i * f.ENCODED_SIZE : (i + 1) * f.ENCODED_SIZE],
+                               "little")
+                for i in range(len(prefixes))]
+
+    encode_agg_share = encode_out_share
+    decode_agg_share = decode_out_share
+
+    # -- prep-state persistence (the datastore is the checkpoint) ---------
+
+    def encode_prep_state(self, state: PrepState, current_round: int) -> bytes:
+        level, _ = self._bound()
+        f = self._field(level)
+        agg_id, _lv, a_s, b_s, c_s = state.poplar
+        out = struct.pack(">BB", current_round, agg_id)
+        out += _encode_int_list(f, [a_s, b_s, c_s])
+        out += _encode_int_list(f, state.out_share)
+        return out
+
+    def encode_transition(self, transition) -> bytes:
+        """Persist a ping-pong transition (WaitingLeader{transition} —
+        reference models.rs:855): state || round || prep message bytes."""
+        state_bytes = self.encode_prep_state(transition.prep_state,
+                                             transition.current_round)
+        return (struct.pack(">I", len(state_bytes)) + state_bytes
+                + transition.prep_msg_bytes)
+
+    def decode_transition(self, data: bytes):
+        from janus_tpu.vdaf import ping_pong
+
+        (n,) = struct.unpack(">I", data[:4])
+        state, rnd = self.decode_prep_state(data[4 : 4 + n])
+        return ping_pong.PingPongTransition(self, state, data[4 + n :], rnd)
+
+    def decode_prep_state(self, data: bytes) -> tuple[PrepState, int]:
+        level, prefixes = self._bound()
+        f = self._field(level)
+        current_round, agg_id = struct.unpack(">BB", data[:2])
+        off = 2
+        es = f.ENCODED_SIZE
+        vals = [int.from_bytes(data[off + i * es : off + (i + 1) * es],
+                               "little") for i in range(3 + len(prefixes))]
+        a_s, b_s, c_s = vals[:3]
+        state = PrepState(vals[3:], None)
+        state.poplar = (agg_id, level, a_s, b_s, c_s)
+        return state, current_round
+
+
+def _encode_int_list(f, vals) -> bytes:
+    return b"".join(v.to_bytes(f.ENCODED_SIZE, "little") for v in vals)
+
+
+def new_poplar1(bits: int) -> Poplar1:
+    return Poplar1(bits)

@@ -69,6 +69,9 @@ class PrepShare:
 @dataclass
 class PrepMessage:
     joint_rand_seed: bytes | None
+    # Multi-round VDAFs (Poplar1) carry public round values here; Prio3's
+    # message is just the corrected joint-rand seed.
+    payload: list | None = None
 
 
 class Prio3:

@@ -1,0 +1,48 @@
+"""Sample configs in docs/samples/ must parse (the reference's
+documentation_config_examples test, janus_cli.rs:892)."""
+
+import os
+
+from janus_tpu.config import (
+    AggregatorBinaryConfig,
+    CreatorBinaryConfig,
+    DriverBinaryConfig,
+    load_config,
+)
+
+SAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "docs", "samples")
+
+
+def test_sample_configs_parse():
+    cfg = load_config(AggregatorBinaryConfig,
+                      os.path.join(SAMPLES, "aggregator.yaml"))
+    assert cfg.listen_address == "0.0.0.0:8080"
+    assert cfg.aggregator_api_listen_address == "127.0.0.1:8081"
+    cfg = load_config(CreatorBinaryConfig,
+                      os.path.join(SAMPLES, "aggregation_job_creator.yaml"))
+    assert cfg.min_aggregation_job_size == 10
+    for name in ("aggregation_job_driver.yaml", "collection_job_driver.yaml"):
+        cfg = load_config(DriverBinaryConfig, os.path.join(SAMPLES, name))
+        assert cfg.job_driver.worker_lease_duration_s == 600
+
+
+def test_sample_tasks_provision(tmp_path):
+    import base64
+    import subprocess
+    import sys
+
+    key = base64.urlsafe_b64encode(bytes(16)).rstrip(b"=").decode()
+    db = str(tmp_path / "t.db")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    repo = os.path.dirname(SAMPLES.rstrip("/")).rsplit("/docs", 1)[0]
+    r = subprocess.run([sys.executable, "-m", "janus_tpu.tools", "write-schema",
+                       "--db", db], capture_output=True, cwd=repo, env=env,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([sys.executable, "-m", "janus_tpu.tools",
+                        "provision-tasks", "--db", db, "--datastore-keys", key,
+                        os.path.join(SAMPLES, "tasks.yaml")],
+                       capture_output=True, cwd=repo, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert b"provisioned 1 task(s)" in r.stdout

@@ -1,0 +1,148 @@
+"""Poplar1: IDPF correctness, two-round sketch, forgery rejection, and the
+full two-aggregator service flow (collection-driven aggregation parameter,
+multi-round ping-pong over HTTP — reference core/src/vdaf.rs:95)."""
+
+import os
+
+import pytest
+
+from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+from janus_tpu.aggregator.aggregation_job_creator import AggregationJobCreator
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.collector import Collector
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.datastore import ephemeral_datastore
+from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+from janus_tpu.messages import Interval, Query, Time
+from janus_tpu.models import VdafInstance
+from janus_tpu.vdaf import ping_pong
+from janus_tpu.vdaf.idpf import Field255, Idpf
+from janus_tpu.vdaf.field_ref import Field64
+from janus_tpu.vdaf.poplar1 import (
+    decode_agg_param,
+    encode_agg_param,
+    new_poplar1,
+)
+
+
+def test_idpf_shares_point_function():
+    idpf = Idpf(bits=6, value_len=2, nonce=b"n" * 16)
+    alpha = 0b101100
+    betas = [[1, 10 + lv] for lv in range(6)]
+    k0, k1 = idpf.gen(alpha, betas, rand=os.urandom(32))
+    for level in [0, 2, 5]:
+        f = Field255 if level == 5 else Field64
+        on_path = alpha >> (5 - level)
+        for prefix in range(1 << (level + 1)):
+            v0 = idpf.eval_prefix(k0, level, prefix)
+            v1 = idpf.eval_prefix(k1, level, prefix)
+            total = [f.add(a, b) for a, b in zip(v0, v1)]
+            assert total == (betas[level] if prefix == on_path else [0, 0])
+
+
+def test_agg_param_roundtrip():
+    data = encode_agg_param(3, [0b1011, 0b0001])
+    assert decode_agg_param(data) == (3, [0b1011, 0b0001])
+    from janus_tpu.vdaf.prio3 import VdafError
+
+    with pytest.raises(VdafError):
+        decode_agg_param(data[:-1])
+
+
+def test_poplar1_two_round_prepare_and_forgery():
+    base = new_poplar1(8)
+    vk = bytes(range(16))
+    vdaf = base.with_agg_param(encode_agg_param(3, [0b1011, 0b0110]))
+    nonce = bytes(16)
+    pub, shares = vdaf.shard(0b10110010, nonce, os.urandom(base.RAND_SIZE))
+    lstate, init = ping_pong.leader_initialized(vdaf, vk, nonce, pub, shares[0])
+    hstate, cont = ping_pong.helper_initialized(
+        vdaf, vk, nonce, pub, shares[1], init).evaluate()
+    assert not hstate.finished
+    lres = ping_pong.continued(vdaf, lstate, cont)
+    lfin, finish = lres.evaluate()
+    assert lfin.finished
+    hfin = ping_pong.continued(vdaf, hstate, finish)
+    f = Field64
+    combined = [f.add(a, b) for a, b in zip(lfin.out_share, hfin.out_share)]
+    assert combined == [1, 0]
+
+    # forged correlated randomness -> sketch rejects
+    pub, shares = vdaf.shard(0b10110010, nonce, os.urandom(base.RAND_SIZE))
+    key, _seed, off = shares[1]
+    shares[1] = (key, bytes(16), off)
+    lstate, init = ping_pong.leader_initialized(vdaf, vk, nonce, pub, shares[0])
+    from janus_tpu.vdaf.prio3 import VdafError
+
+    with pytest.raises(VdafError):
+        hstate, cont = ping_pong.helper_initialized(
+            vdaf, vk, nonce, pub, shares[1], init).evaluate()
+        lres = ping_pong.continued(vdaf, lstate, cont)
+        lres.evaluate()
+
+
+def test_poplar1_service_end_to_end():
+    """Upload -> collection job supplies the agg param -> creator/driver run
+    the 2-round exchange over HTTP -> collector gets per-prefix counts."""
+    inst = VdafInstance.poplar1(8)
+    builder = TaskBuilder(QueryTypeCfg.time_interval(), inst)
+    builder.with_min_batch_size(3)
+    clock = MockClock(Time(1_700_000_000))
+    helper_ds, leader_ds = ephemeral_datastore(clock), ephemeral_datastore(clock)
+    helper_agg = Aggregator(helper_ds, clock,
+                            AggregatorConfig(batch_aggregation_shard_count=2))
+    leader_agg = Aggregator(leader_ds, clock,
+                            AggregatorConfig(batch_aggregation_shard_count=2))
+    hs = DapHttpServer(helper_agg).start()
+    ls = DapHttpServer(leader_agg).start()
+    try:
+        builder.helper_endpoint = hs.address
+        builder.leader_endpoint = ls.address
+        helper_ds.run_tx("p", lambda tx: tx.put_aggregator_task(
+            builder.helper_view()))
+        leader_ds.run_tx("p", lambda tx: tx.put_aggregator_task(
+            builder.leader_view()))
+
+        client = Client(
+            ClientParameters(builder.task_id, ls.address, hs.address,
+                             builder.time_precision), inst, clock=clock)
+        for alpha in (0b10110010, 0b10110010, 0b01100001):
+            client.upload(alpha)
+        leader_agg.report_writer.flush()
+
+        # no aggregation parameter yet -> creator produces nothing
+        creator = AggregationJobCreator(leader_ds, 1, 10,
+                                        batch_aggregation_shard_count=2)
+        assert creator.run_once() == 0
+
+        agg_param = encode_agg_param(3, [0b1011, 0b0110, 0b1111])
+        collector = Collector(builder.task_id, ls.address,
+                              builder.collector_auth_token,
+                              builder.collector_keypair, inst)
+        interval = Interval(clock.now().round_down(builder.time_precision),
+                            builder.time_precision)
+        query = Query.time_interval(interval)
+        job_id = collector.start_collection(query, agg_param)
+
+        assert creator.run_once() == 1
+        drv = AggregationJobDriver(leader_ds, batch_aggregation_shard_count=2)
+        # two driver rounds: init exchange (persists WAITING_LEADER
+        # transitions), then the continue exchange finishes the reports
+        assert JobDriver(JobDriverConfig(), drv.acquirer, drv.stepper
+                         ).run_once() == 1
+        assert JobDriver(JobDriverConfig(), drv.acquirer, drv.stepper
+                         ).run_once() == 1
+        cdrv = CollectionJobDriver(leader_ds)
+        assert JobDriver(JobDriverConfig(), cdrv.acquirer, cdrv.stepper
+                         ).run_once() == 1
+
+        result = collector.poll_once(job_id, query, agg_param)
+        assert result is not None
+        assert result.report_count == 3
+        assert result.aggregate_result == [2, 1, 0]
+    finally:
+        hs.stop()
+        ls.stop()

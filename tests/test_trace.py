@@ -1,0 +1,33 @@
+"""Tracing subsystem (SURVEY.md §5.1; reference trace.rs:119)."""
+
+import io
+import json
+
+from janus_tpu.trace import TraceConfiguration, install_trace_subscriber
+
+
+def test_span_nesting_and_json_output():
+    buf = io.StringIO()
+    sub = install_trace_subscriber(TraceConfiguration(
+        level="debug", use_json=True, stream=buf))
+    with sub.span("outer", task="t"):
+        with sub.span("VDAF preparation", reports=10):
+            pass
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert lines[0]["spans"] == "outer:VDAF preparation"
+    assert lines[0]["reports"] == 10
+    assert lines[0]["duration_ms"] >= 0
+    assert lines[1]["spans"] == "outer"
+    install_trace_subscriber()  # reset process-global default
+
+
+def test_level_filtering():
+    buf = io.StringIO()
+    sub = install_trace_subscriber(TraceConfiguration(level="warn", stream=buf))
+    sub.emit("info", "hidden")
+    sub.emit("warn", "shown", code=7)
+    with sub.span("quiet"):
+        pass  # debug span output filtered at warn level
+    out = buf.getvalue()
+    assert "hidden" not in out and "shown" in out and "quiet" not in out
+    install_trace_subscriber()
