@@ -63,8 +63,11 @@ class AggregationJobCreator:
     def create_jobs_for_task(self, task) -> int:
         # VDAFs with aggregation parameters (Poplar1) can only be aggregated
         # once a collection job supplies the parameter (the reference creates
-        # these jobs on demand from collection state).
-        requires_param = task.vdaf.kind == "Poplar1"
+        # these jobs on demand from collection state).  Detected structurally
+        # so future parameterized VDAFs take this path too.
+        from janus_tpu.models.vdaf_instance import prep_engine
+
+        requires_param = hasattr(prep_engine(task.vdaf).vdaf, "with_agg_param")
 
         def txn(tx):
             if requires_param:

@@ -536,14 +536,24 @@ class Aggregator:
             # report under a DIFFERENT parameter (Poplar1 tree levels) is not
             # a replay (reference aggregator.rs:2100-2136).
             final = []
+            seq_check = getattr(ta.vdaf, "is_valid_agg_param_sequence", None)
             for w in writables:
                 ra = w.report_aggregation
                 try:
                     tx.put_scrubbed_report(task_id, ra.report_id, ra.time)
                 except MutationTargetAlreadyExists:
                     pass  # the report-id row may exist from another parameter
-                if tx.check_report_replayed(task_id, ra.report_id, job_id,
-                                            req.aggregation_parameter):
+                replayed = tx.check_report_replayed(
+                    task_id, ra.report_id, job_id, req.aggregation_parameter)
+                if not replayed and seq_check is not None:
+                    # agg-param validity (Poplar1: strictly increasing
+                    # levels per report) bounds what a malicious leader can
+                    # learn by re-querying one report
+                    prior = tx.get_report_aggregation_params(
+                        task_id, ra.report_id, job_id)
+                    if not seq_check(prior, req.aggregation_parameter):
+                        replayed = True
+                if replayed:
                     if ra.state.kind is not m.ReportAggregationStateKind.FAILED:
                         w = w.with_failure(PrepareError.REPORT_REPLAYED)
                 final.append(w)

@@ -507,20 +507,41 @@ class Transaction:
     def get_report_batch_assignments(self, task_id: TaskId,
                                      report_ids: list[ReportId]) -> dict:
         """report id bytes -> BatchId from the report's first fixed-size
-        aggregation, for batch-membership reuse across Poplar1 levels."""
+        aggregation, for batch-membership reuse across Poplar1 levels.
+        One set query per chunk (sqlite's bound-variable limit)."""
         out: dict[bytes, BatchId] = {}
-        for rid in report_ids:
-            row = self._exec(
-                """SELECT aj.batch_id FROM report_aggregations ra
-                   JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
-                    AND ra.aggregation_job_id = aj.aggregation_job_id
-                   WHERE ra.task_id = ? AND ra.report_id = ?
-                     AND aj.batch_id IS NOT NULL LIMIT 1""",
-                (bytes(task_id), bytes(rid)),
-            ).fetchone()
-            if row is not None:
-                out[bytes(rid)] = BatchId(row[0])
+        ids = [bytes(r) for r in report_ids]
+        for start in range(0, len(ids), 500):
+            chunk = ids[start : start + 500]
+            marks = ",".join("?" * len(chunk))
+            rows = self._exec(
+                f"""SELECT ra.report_id, MIN(aj.batch_id)
+                    FROM report_aggregations ra
+                    JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
+                     AND ra.aggregation_job_id = aj.aggregation_job_id
+                    WHERE ra.task_id = ? AND aj.batch_id IS NOT NULL
+                      AND ra.report_id IN ({marks})
+                    GROUP BY ra.report_id""",
+                (bytes(task_id), *chunk),
+            ).fetchall()
+            for rid, bid in rows:
+                out[rid] = BatchId(bid)
         return out
+
+    def get_report_aggregation_params(self, task_id: TaskId,
+                                      report_id: ReportId,
+                                      exclude_job: AggregationJobId) -> list[bytes]:
+        """Distinct aggregation parameters this report was already aggregated
+        under (agg-param sequence enforcement for Poplar1)."""
+        rows = self._exec(
+            """SELECT DISTINCT aj.aggregation_param FROM report_aggregations ra
+               JOIN aggregation_jobs aj ON ra.task_id = aj.task_id
+                AND ra.aggregation_job_id = aj.aggregation_job_id
+               WHERE ra.task_id = ? AND ra.report_id = ?
+                 AND ra.aggregation_job_id != ?""",
+            (bytes(task_id), bytes(report_id), bytes(exclude_job)),
+        ).fetchall()
+        return [r[0] for r in rows]
 
     def count_unaggregated_reports_for_param_in_interval(
         self, task_id: TaskId, aggregation_parameter: bytes,

@@ -9,7 +9,7 @@ Field255 pairs (value, authenticator).
 
 The PRG is AES-128 with a fixed key acting as an extend/convert function
 (cheap per-node expansion; the fixed key is derived once per (nonce, dst)).
-Correctness property (pinned in tests/test_idpf.py): for every level L and
+Correctness property (pinned in tests/test_poplar1.py): for every level L and
 candidate prefix p,  Eval(key0, p) + Eval(key1, p) == beta_L if p is a
 prefix of alpha else 0.
 """

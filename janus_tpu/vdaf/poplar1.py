@@ -162,8 +162,12 @@ class Poplar1:
                                         prep_shares[1].verifiers)
         ]
         if len(combined) == 3:
-            # round 1 -> broadcast (Z', Zs', ZC)
-            if combined[2] != 1:
+            # round 1 -> broadcast (Z', Zs', ZC).  The valid outputs are a
+            # standard basis vector (client's prefix is a candidate, ZC == 1)
+            # or the ZERO vector (client pruned at this level, ZC == 0) —
+            # rejecting off-path clients would break heavy-hitter levels
+            # below the root and leak membership.
+            if combined[2] not in (0, 1):
                 raise VdafError("Poplar1 count check failed")
             return PrepMessage(None, payload=combined)
         # round 2 -> sigma must combine to zero
@@ -186,6 +190,25 @@ class Poplar1:
         nxt = PrepState(state.out_share, None)
         nxt.poplar = state.poplar
         return nxt, PrepShare(None, [sigma])
+
+    @staticmethod
+    def is_valid_agg_param_sequence(prior: list[bytes], new: bytes) -> bool:
+        """VDAF agg-param validity for Poplar1: levels strictly increase per
+        report and each level is queried at most once.  Without this a
+        malicious leader could re-evaluate one report under adaptively chosen
+        prefix sets and binary-search the client's input."""
+        try:
+            new_level, _ = decode_agg_param(new)
+        except VdafError:
+            return False
+        for p in prior:
+            try:
+                level, _ = decode_agg_param(p)
+            except VdafError:
+                continue
+            if level >= new_level:
+                return False
+        return True
 
     # -- aggregation -------------------------------------------------------
 

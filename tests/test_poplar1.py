@@ -146,3 +146,38 @@ def test_poplar1_service_end_to_end():
     finally:
         hs.stop()
         ls.stop()
+
+
+def test_pruned_client_contributes_zero_vector():
+    """Clients whose alpha is under NO candidate prefix must still verify
+    (zero-vector contribution) — heavy-hitter levels below the root prune
+    most clients."""
+    base = new_poplar1(4)
+    vk = bytes(range(16))
+    vdaf = base.with_agg_param(encode_agg_param(1, [0b00, 0b01]))
+    nonce = bytes(16)
+    # alpha = 0b1010 -> level-1 prefix 0b10, NOT a candidate
+    pub, shares = vdaf.shard(0b1010, nonce, os.urandom(base.RAND_SIZE))
+    lstate, init = ping_pong.leader_initialized(vdaf, vk, nonce, pub, shares[0])
+    hstate, cont = ping_pong.helper_initialized(
+        vdaf, vk, nonce, pub, shares[1], init).evaluate()
+    lfin, finish = ping_pong.continued(vdaf, lstate, cont).evaluate()
+    hfin = ping_pong.continued(vdaf, hstate, finish)
+    combined = [Field64.add(a, b)
+                for a, b in zip(lfin.out_share, hfin.out_share)]
+    assert combined == [0, 0]
+
+
+def test_agg_param_sequence_enforced():
+    """Levels must strictly increase per report: same or earlier levels with
+    different prefix sets are rejected (binary-search privacy guard)."""
+    vdaf = new_poplar1(8)
+    p_l3 = encode_agg_param(3, [0b1011])
+    p_l3b = encode_agg_param(3, [0b0110])
+    p_l5 = encode_agg_param(5, [0b101100])
+    p_l2 = encode_agg_param(2, [0b101])
+    assert vdaf.is_valid_agg_param_sequence([], p_l3)
+    assert vdaf.is_valid_agg_param_sequence([p_l3], p_l5)
+    assert not vdaf.is_valid_agg_param_sequence([p_l3], p_l3b)  # same level
+    assert not vdaf.is_valid_agg_param_sequence([p_l3], p_l2)   # went back
+    assert not vdaf.is_valid_agg_param_sequence([p_l3, p_l5], p_l5)

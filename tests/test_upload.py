@@ -1,0 +1,138 @@
+"""Leader upload-path validation: rejection reasons, upload counters, and
+duplicate handling (reference aggregator.rs:1513-1678, report_writer.rs)."""
+
+import requests
+
+from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.datastore import ephemeral_datastore
+from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+from janus_tpu.messages import Duration, Report, Time
+from janus_tpu.models import VdafInstance
+
+
+def _leader():
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          VdafInstance.prio3_count())
+    builder.with_report_expiry_age(Duration(7200))
+    clock = MockClock(Time(1_700_000_000))
+    ds = ephemeral_datastore(clock)
+    task = builder.leader_view()
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(task))
+    agg = Aggregator(ds, clock, AggregatorConfig(max_upload_batch_size=1))
+    server = DapHttpServer(agg).start()
+    client = Client(
+        ClientParameters(builder.task_id, server.address, "http://h.invalid",
+                         builder.time_precision),
+        VdafInstance.prio3_count(),
+        leader_hpke_config=builder.leader_hpke_keypair.config,
+        helper_hpke_config=builder.helper_hpke_keypair.config,
+        clock=clock)
+    return builder, task, clock, ds, agg, server, client
+
+
+def _counter(ds, task_id):
+    return ds.run_tx("c", lambda tx: tx.get_task_upload_counter(task_id))
+
+
+def test_upload_rejections_and_counters():
+    builder, task, clock, ds, agg, server, client = _leader()
+    try:
+        url = f"{server.address}/tasks/{task.task_id}/reports"
+
+        # success
+        client.upload(1)
+        assert _counter(ds, task.task_id).report_success == 1
+
+        # too far in the future -> reportTooEarly
+        report = client.prepare_report(1, time=clock.now().add(Duration(7200)))
+        r = requests.put(url, data=report.encode(),
+                         headers={"Content-Type": Report.MEDIA_TYPE})
+        assert r.status_code == 400
+        assert r.json()["type"].endswith("reportTooEarly")
+        assert _counter(ds, task.task_id).report_too_early == 1
+
+        # expired (older than report_expiry_age) -> reportRejected
+        report = client.prepare_report(1, time=clock.now().sub(Duration(8000)))
+        r = requests.put(url, data=report.encode(),
+                         headers={"Content-Type": Report.MEDIA_TYPE})
+        assert r.status_code == 400
+        assert r.json()["type"].endswith("reportRejected")
+        assert _counter(ds, task.task_id).report_expired == 1
+
+        # unknown HPKE config id -> outdatedConfig
+        rogue = HpkeKeypair.generate(200)
+        bad_client = Client(client.params, VdafInstance.prio3_count(),
+                            leader_hpke_config=rogue.config,
+                            helper_hpke_config=builder.helper_hpke_keypair.config,
+                            clock=clock)
+        report = bad_client.prepare_report(1, time=clock.now())
+        r = requests.put(url, data=report.encode(),
+                         headers={"Content-Type": Report.MEDIA_TYPE})
+        assert r.status_code == 400
+        assert r.json()["type"].endswith("outdatedConfig")
+        assert _counter(ds, task.task_id).report_outdated_key == 1
+
+        # garbled ciphertext under a KNOWN config id -> decryptFailure
+        good = client.prepare_report(1, time=clock.now())
+        from janus_tpu.messages import HpkeCiphertext
+
+        tampered = Report(
+            good.metadata, good.public_share,
+            HpkeCiphertext(good.leader_encrypted_input_share.config_id,
+                           good.leader_encrypted_input_share.encapsulated_key,
+                           b"\x00" * 40),
+            good.helper_encrypted_input_share)
+        r = requests.put(url, data=tampered.encode(),
+                         headers={"Content-Type": Report.MEDIA_TYPE})
+        assert r.status_code == 400
+        assert _counter(ds, task.task_id).report_decrypt_failure == 1
+
+        # duplicate upload: accepted idempotently, not double-counted
+        report = client.prepare_report(1, time=clock.now())
+        for _ in range(2):
+            r = requests.put(url, data=report.encode(),
+                             headers={"Content-Type": Report.MEDIA_TYPE})
+            assert r.status_code == 201
+        assert _counter(ds, task.task_id).report_success == 2
+
+        # malformed body -> invalidMessage
+        r = requests.put(url, data=b"\x01\x02",
+                         headers={"Content-Type": Report.MEDIA_TYPE})
+        assert r.status_code == 400
+        assert r.json()["type"].endswith("invalidMessage")
+    finally:
+        server.stop()
+
+
+def test_upload_task_expired():
+    builder, task, clock, ds, agg, server, client = _leader()
+    server.stop()
+    # rebuild with an already-expired task
+    builder2 = TaskBuilder(QueryTypeCfg.time_interval(),
+                           VdafInstance.prio3_count())
+    builder2.with_task_expiration(Time(1_600_000_000))
+    clock = MockClock(Time(1_700_000_000))
+    ds = ephemeral_datastore(clock)
+    task = builder2.leader_view()
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(task))
+    agg = Aggregator(ds, clock, AggregatorConfig(max_upload_batch_size=1))
+    server = DapHttpServer(agg).start()
+    try:
+        client = Client(
+            ClientParameters(builder2.task_id, server.address, "http://h",
+                             builder2.time_precision),
+            VdafInstance.prio3_count(),
+            leader_hpke_config=builder2.leader_hpke_keypair.config,
+            helper_hpke_config=builder2.helper_hpke_keypair.config,
+            clock=clock)
+        report = client.prepare_report(1, time=clock.now())
+        r = requests.put(f"{server.address}/tasks/{task.task_id}/reports",
+                         data=report.encode(),
+                         headers={"Content-Type": Report.MEDIA_TYPE})
+        assert r.status_code == 400
+        assert _counter(ds, task.task_id).task_expired == 1
+    finally:
+        server.stop()
