@@ -178,7 +178,7 @@ class AggregationJobWriter:
         if (first is not None
                 and all(w.device_shares is first and w.lane is not None
                         for w in finished)):
-            mask = np.zeros(first.shape[0], dtype=bool)
+            mask = np.zeros(first.shape[-1], dtype=bool)  # batch axis is minor
             for w in finished:
                 mask[w.lane] = True
             return self.engine.aggregate_masked(first, mask)

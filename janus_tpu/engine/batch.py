@@ -91,6 +91,10 @@ class LaneRef:
     device every eager op is a round trip, so per-lane slicing in the result
     loop would cost thousands of them).  `np.asarray(ref)` materializes just
     that lane when host code genuinely needs the values.
+
+    The resident batch is in the kernels' limb-leading / batch-minor layout
+    ([LIMBS, OUTPUT_LEN, M]); materializing transposes the lane back to the
+    host-side row layout ([OUTPUT_LEN, LIMBS]).
     """
 
     __slots__ = ("array", "lane")
@@ -100,7 +104,7 @@ class LaneRef:
         self.lane = lane
 
     def __array__(self, dtype=None, copy=None):
-        out = np.asarray(self.array[self.lane])
+        out = np.asarray(self.array[..., self.lane]).T
         return out.astype(dtype) if dtype is not None else out
 
 
@@ -117,10 +121,10 @@ class PreparedReport:
     status: str  # "finished" | "continued" | "failed"
     error: str | None = None
     outbound: ping_pong.PingPongMessage | None = None
-    out_share_raw: object | None = None  # [OUTPUT_LEN, L] uint32 (np or jax)
+    out_share_raw: object | None = None  # [OUTPUT_LEN, L] uint32 (np or LaneRef)
     prep_share: bytes | None = None
     state: object | None = None  # leader: PingPongContinued
-    device_shares: object | None = None  # jax [M, OUTPUT_LEN, L], whole batch
+    device_shares: object | None = None  # jax [L, OUTPUT_LEN, M], whole batch
     lane: int | None = None
 
 
@@ -185,19 +189,27 @@ class BatchPrio3:
 
         return round_up(bucket_size(n), self._n_devices)
 
-    def _jit(self, kernel, n_sharded_args: int):
-        """jit, sharding every batch argument/output on the report axis when
-        a mesh is configured (the verify key stays replicated)."""
+    def _jit(self, kernel, n_sharded_args: int, out_specs):
+        """jit, sharding batch arguments/outputs over the report mesh when
+        one is configured.
+
+        Wire-layout inputs are batch-leading (sharded on axis 0, after the
+        replicated verify key); `out_specs` gives each output's (axis, rank)
+        batch position — host-bound rows are batch-leading, device-resident
+        field tensors batch-minor."""
         if self.mesh is None:
             return jax.jit(kernel)
         from janus_tpu.parallel import replicated, report_sharding
 
-        shard = report_sharding(self.mesh)
         rep = replicated(self.mesh)
+        shard = report_sharding(self.mesh)
         return jax.jit(
             kernel,
             in_shardings=(rep,) + (shard,) * n_sharded_args,
-            out_shardings=shard,
+            out_shardings=tuple(
+                report_sharding(self.mesh, axis=ax, rank=rk)
+                for ax, rk in out_specs
+            ),
         )
 
     # -- host-side decoding helpers --------------------------------------
@@ -259,13 +271,15 @@ class BatchPrio3:
     def _kernel_common(self, bs, meas_raw, proofs_raw, nonces, vk, parts_static):
         """Shared tail: joint/query randomness + FLP query.
 
+        meas_raw / proofs_raw are raw limbs in the kernel layout
+        ([L, n, N], batch minor); nonces/seeds are u8 rows ([N, k], batch
+        leading — byte tensors are tiny and feed sponge message assembly).
         parts_static: the peer's joint-rand part [N, 16] from the public
         share, in aggregator order around `own_part`.
-        Returns (verifier_internal [N, P, VLEN, L], state_seed [N,16] u8 or
-        None, reject [N], bad_t [N], meas_internal).
+        Returns (verifier [L, P, VLEN, N], state_seed [N, 16] u8 or None,
+        reject [N], bad_t [N], meas_internal [L, MEAS_LEN, N]).
         """
         f = self.f
-        N = bs[0]
         P = self.P
         ss = self.vdaf.SEED_SIZE
         reject = jnp.zeros(bs, dtype=bool)
@@ -279,25 +293,28 @@ class BatchPrio3:
                 P * self.flp.JOINT_RAND_LEN,
             )
             reject = reject | rej
-            jr = f.from_raw(jr_raw).reshape(bs + (P, self.flp.JOINT_RAND_LEN, self.L))
+            jr = f.from_raw(jr_raw).reshape(
+                (self.L, P, self.flp.JOINT_RAND_LEN) + bs)
         else:
             state_seed = None
-            jr = f.zeros(bs + (P, 0))
+            jr = f.zeros((P, 0) + bs)
         qr_raw, rej = self.xops.expand(
             bs, jnp.broadcast_to(vk, bs + (self.vdaf.VERIFY_KEY_SIZE,)),
             self._dst(USAGE_QUERY_RANDOMNESS), [nonces],
             P * self.flp.QUERY_RAND_LEN,
         )
         reject = reject | rej
-        qr = f.from_raw(qr_raw).reshape(bs + (P, self.flp.QUERY_RAND_LEN, self.L))
+        qr = f.from_raw(qr_raw).reshape(
+            (self.L, P, self.flp.QUERY_RAND_LEN) + bs)
 
         meas = f.from_raw(meas_raw)
-        proofs = f.from_raw(proofs_raw).reshape(bs + (P, self.flp.PROOF_LEN, self.L))
+        proofs = f.from_raw(proofs_raw).reshape(
+            (self.L, P, self.flp.PROOF_LEN) + bs)
         meas_b = jnp.broadcast_to(
-            meas[:, None], bs + (P, self.flp.MEAS_LEN, self.L)
+            meas[:, None], (self.L, P, self.flp.MEAS_LEN) + bs
         )
         verifier, bad_t = self.bflp.query(meas_b, proofs, qr, jr, self.vdaf.shares)
-        bad_t = jnp.any(bad_t, axis=-1)
+        bad_t = jnp.any(bad_t, axis=0)  # over the proof axis
         return verifier, state_seed, reject, bad_t, meas
 
     def _helper_fn(self, N: int):
@@ -332,10 +349,14 @@ class BatchPrio3:
                 bs, meas_raw, proofs_raw, nonces, vk, parts
             )
             reject = reject | rej3
-            # prep_shares_to_prep: combine, decide, message seed from claimed parts
-            lv = f.from_raw(leader_verifs_raw).reshape(bs + (P, vlen, self.L))
+            # prep_shares_to_prep: combine, decide, message seed from claimed
+            # parts.  The leader's verifier arrives in wire layout
+            # [N, P*vlen, L]; one transpose moves it into the kernel layout.
+            lv = f.from_raw(
+                jnp.transpose(leader_verifs_raw, (2, 1, 0))
+            ).reshape((self.L, P, vlen) + bs)
             total = f.add(verifier, lv)
-            proof_ok = jnp.all(self.bflp.decide(total), axis=-1)
+            proof_ok = jnp.all(self.bflp.decide(total), axis=0)
             if self.has_jr:
                 msg_seed = self.xops.derive_seed(
                     bs, bytes(ss), self._dst(USAGE_JOINT_RAND_SEED),
@@ -344,12 +365,13 @@ class BatchPrio3:
             else:
                 msg_seed = jnp.zeros(bs + (ss,), dtype=jnp.uint8)
                 jr_ok = jnp.ones(bs, dtype=bool)
-            out_share = f.to_raw(self.bflp.truncate(meas))
+            out_share = f.to_raw(self.bflp.truncate(meas))  # [L, OUT, N]
             # The 1-round helper sends only the finish seed on the wire, so
             # neither its verifier nor its joint-rand part leaves the device.
             return (msg_seed, out_share, proof_ok, jr_ok, reject | bad_t)
 
-        fn = self._jit(kernel, 6)
+        fn = self._jit(kernel, 6, out_specs=(
+            (0, 2), (2, 3), (0, 1), (0, 1), (0, 1)))
         self._helper_fns[N] = fn
         return fn
 
@@ -360,9 +382,12 @@ class BatchPrio3:
         P = self.P
         vlen = self.flp.VERIFIER_LEN
 
-        def kernel(vk, meas_raw, proofs_raw, blinds, nonces, pub1):
+        def kernel(vk, meas_rows, proofs_rows, blinds, nonces, pub1):
             bs = (N,)
             ss = self.vdaf.SEED_SIZE
+            # wire-layout inputs [N, n, L] -> kernel layout [L, n, N]
+            meas_raw = jnp.transpose(meas_rows, (2, 1, 0))
+            proofs_raw = jnp.transpose(proofs_rows, (2, 1, 0))
             if self.has_jr:
                 meas_bytes = xof_batch.vec_limbs_to_bytes(meas_raw)
                 own_part = self.xops.derive_seed(
@@ -375,13 +400,16 @@ class BatchPrio3:
             verifier, state_seed, reject, bad_t, meas = self._kernel_common(
                 bs, meas_raw, proofs_raw, nonces, vk, parts
             )
-            out_share = f.to_raw(self.bflp.truncate(meas))
-            verif_raw = f.to_raw(verifier).reshape(bs + (P * vlen, self.L))
+            out_share = f.to_raw(self.bflp.truncate(meas))  # [L, OUT, N]
+            # the leader's verifier IS wire payload: back to row layout
+            verif_raw = jnp.transpose(
+                f.to_raw(verifier).reshape((self.L, P * vlen) + bs), (2, 1, 0))
             if state_seed is None:
                 state_seed = jnp.zeros(bs + (ss,), dtype=jnp.uint8)
             return verif_raw, own_part, state_seed, out_share, reject | bad_t
 
-        fn = self._jit(kernel, 5)
+        fn = self._jit(kernel, 5, out_specs=(
+            (0, 3), (0, 2), (0, 2), (2, 3), (0, 1)))
         self._leader_fns[N] = fn
         return fn
 
@@ -468,7 +496,7 @@ class BatchPrio3:
 
         t0 = _t.monotonic()
         # Only the small per-lane outputs come back to the host; the output
-        # shares ([M, OUTPUT_LEN, L] — by far the largest tensor) and the
+        # shares ([L, OUTPUT_LEN, M] — by far the largest tensor) and the
         # helper verifier stay on device.  Downstream aggregation reduces
         # out_share_d with a lane mask and transfers one [OUTPUT_LEN, L] sum
         # per batch (HBM-bandwidth discipline; the 1-round helper never
@@ -687,24 +715,25 @@ class BatchPrio3:
         """Device tree-sum of raw output-share rows -> aggregate share ints."""
         if not rows:
             return self.vdaf.aggregate_init()
-        rows = [np.asarray(r) for r in rows]
+        rows = [np.asarray(r) for r in rows]  # each [OUTPUT_LEN, L]
         K = len(rows)
         M = self._bucket(K)
-        arr = np.zeros((M,) + tuple(rows[0].shape), dtype=np.uint32)
-        arr[:K] = np.stack(rows)
+        arr = np.zeros((self.L, rows[0].shape[0], M), dtype=np.uint32)
+        arr[:, :, :K] = np.stack(rows, axis=-1).transpose(1, 0, 2)
         mask = np.zeros(M, dtype=bool)
         mask[:K] = True
         return self.aggregate_masked(arr, mask)
 
     def aggregate_masked(self, shares, mask) -> list[int]:
         """Masked modular sum over the report axis, entirely on device:
-        `shares` may be the engine's resident [M, OUTPUT_LEN, L] batch array,
-        so only the [OUTPUT_LEN, L] result crosses to the host."""
+        `shares` may be the engine's resident [L, OUTPUT_LEN, M] batch array,
+        so only the [L, OUTPUT_LEN] result crosses to the host."""
         if self._agg_fn is None:
             from janus_tpu.parallel import aggregate_fn
 
             self._agg_fn = aggregate_fn(self.f, self.mesh)
-        return self._raw_to_ints(np.asarray(self._agg_fn(shares, np.asarray(mask))))
+        res = np.asarray(self._agg_fn(shares, np.asarray(mask)))  # [L, OUT]
+        return self._raw_to_ints(res.T)
 
     # -- limb conversion helpers ------------------------------------------
 

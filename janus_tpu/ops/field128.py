@@ -3,12 +3,16 @@
 The 128-bit VDAF field under Prio3Sum / Prio3SumVec / Prio3Histogram
 (reference: the `prio` crate's Field128, consumed via core/src/vdaf.rs:67-87;
 SURVEY.md §2.8).  Like janus_tpu.ops.field64 this is re-designed for the TPU
-VPU — no 64-bit integers, no data-dependent branches — but unlike the
-Goldilocks field, p has no cheap raw reduction, so elements live in
-**Montgomery form** (x·R mod p, R = 2^128) on device:
+VPU — no 64-bit integers, no data-dependent branches.  Unlike the Goldilocks
+field, p has no cheap raw reduction, so elements live in **Montgomery form**
+(x·R mod p, R = 2^128) on device:
 
-- A Field128 array of logical shape S is a uint32 array of shape S + (4,)
+- A Field128 array of logical shape S is a uint32 array of shape (4,) + S
   (limb 0 = least significant 32 bits), in Montgomery form, canonical (< p).
+  The limb axis LEADS and the batch axis is — by engine convention — the
+  MINOR (last) axis of S, so TPU (8, 128) register tiles are filled by the
+  report axis instead of being 4/128 occupied by a trailing limb axis
+  (measured ~4.5x on v5e for exactly this kernel shape).
 - `mul` is CIOS Montgomery multiplication.  Because p ≡ 1 (mod 2^32), the
   per-limb Montgomery factor is m = -t0 mod 2^32: no extra multiply.
 - Raw (standard-form) limb data — e.g. XOF output lanes from
@@ -53,22 +57,24 @@ _P = _limbs(MODULUS)
 
 
 def pack(values) -> np.ndarray:
-    """Python ints -> Montgomery-form uint32 limb array (shape + (4,))."""
-    flat = np.ravel(np.array(values, dtype=object))
+    """Python ints -> Montgomery-form uint32 limb array ((4,) + shape)."""
+    vals = np.array(values, dtype=object)
+    flat = np.ravel(vals)
+    mont = [(int(v) % MODULUS) * R % MODULUS for v in flat]
     arr = np.asarray(
-        [_limbs((int(v) % MODULUS) * R % MODULUS) for v in flat], dtype=np.uint32
+        [[(m >> (32 * i)) & 0xFFFFFFFF for m in mont] for i in range(4)],
+        dtype=np.uint32,
     )
-    shape = np.shape(np.array(values, dtype=object))
-    return arr.reshape(shape + (4,))
+    return arr.reshape((4,) + np.shape(vals))
 
 
 def unpack(x) -> np.ndarray:
     """Montgomery uint32 limb array -> numpy object array of Python ints."""
     x = np.asarray(x)
     rinv = pow(R, MODULUS - 2, MODULUS)
-    acc = np.zeros(x.shape[:-1], dtype=object)
+    acc = np.zeros(x.shape[1:], dtype=object)
     for i in range(4):
-        acc = acc + (x[..., i].astype(object) << (32 * i))
+        acc = acc + (x[i].astype(object) << (32 * i))
     acc = np.asarray(acc, dtype=object)
     flat = np.ravel(acc)
     out = np.array([int(v) * rinv % MODULUS for v in flat], dtype=object)
@@ -76,15 +82,23 @@ def unpack(x) -> np.ndarray:
 
 
 def zeros(shape) -> jnp.ndarray:
-    return jnp.zeros(tuple(shape) + (4,), dtype=_U32)
+    return jnp.zeros((4,) + tuple(shape), dtype=_U32)
 
 
 def ones(shape) -> jnp.ndarray:
-    return jnp.broadcast_to(jnp.asarray(_limbs(R)), tuple(shape) + (4,))
+    sh = tuple(shape)
+    return jnp.broadcast_to(
+        jnp.asarray(_limbs(R)).reshape((4,) + (1,) * len(sh)), (4,) + sh
+    )
 
 
 def const(value: int):
-    """A scalar field constant (Montgomery form) as a (4,) uint32 array."""
+    """A scalar field constant (Montgomery form) as a (4,) uint32 array.
+
+    Safe as the second operand of the field ops (limb slices are scalars and
+    broadcast); for explicit jnp.broadcast_to against a full (4,) + S array,
+    reshape with trailing singleton axes first.
+    """
     return jnp.asarray(_limbs((value % MODULUS) * R % MODULUS))
 
 
@@ -112,49 +126,57 @@ def _mul32(a, b):
 
 
 def _addv(x, y):
-    """4-limb add: ([..., 4], [..., 4]) -> (limbs, carry_out)."""
+    """4-limb add ([4, ...] arrays) -> (limb list, carry_out)."""
     out = []
-    carry = jnp.zeros(x.shape[:-1], dtype=_U32)
+    carry = jnp.zeros(jnp.broadcast_shapes(x.shape[1:], y.shape[1:]), dtype=_U32)
     for i in range(4):
-        s = x[..., i] + y[..., i]
-        c1 = (s < x[..., i]).astype(_U32)
+        s = x[i] + y[i]
+        c1 = (s < x[i]).astype(_U32)
         s2 = s + carry
         c2 = (s2 < carry).astype(_U32)
         out.append(s2)
         carry = c1 | c2  # at most one of the two adds can carry
-    return jnp.stack(out, axis=-1), carry
+    return out, carry
 
 
 def _subv(x, y):
-    """4-limb subtract: -> (limbs, borrow_out)."""
+    """4-limb subtract -> (limb list, borrow_out)."""
     out = []
-    borrow = jnp.zeros(x.shape[:-1], dtype=_U32)
+    borrow = jnp.zeros(jnp.broadcast_shapes(x.shape[1:], y.shape[1:]), dtype=_U32)
     for i in range(4):
-        d = x[..., i] - y[..., i]
-        b1 = (x[..., i] < y[..., i]).astype(_U32)
+        d = x[i] - y[i]
+        b1 = (x[i] < y[i]).astype(_U32)
         d2 = d - borrow
         b2 = (d < borrow).astype(_U32)
         out.append(d2)
         borrow = b1 | b2
-    return jnp.stack(out, axis=-1), borrow
+    return out, borrow
 
 
-def _geq_p(x):
+def _geq_p(limbs):
     """x >= p elementwise over 4-limb values: lexicographic from the top."""
-    gt = jnp.zeros(x.shape[:-1], dtype=bool)
-    eq = jnp.ones(x.shape[:-1], dtype=bool)
+    gt = jnp.zeros(limbs[0].shape, dtype=bool)
+    eq_ = jnp.ones(limbs[0].shape, dtype=bool)
     for i in range(3, -1, -1):
         c = jnp.asarray(np.uint32(_P_LIMBS_INT[i]))
-        gt = gt | (eq & (x[..., i] > c))
-        eq = eq & (x[..., i] == c)
-    return gt | eq
+        gt = gt | (eq_ & (limbs[i] > c))
+        eq_ = eq_ & (limbs[i] == c)
+    return gt | eq_
 
 
-def _cond_sub_p(x, force=None):
-    """Subtract p where x >= p (or where `force`); x < 2p assumed."""
-    need = _geq_p(x) if force is None else (force | _geq_p(x))
-    sub, _ = _subv(x, jnp.broadcast_to(jnp.asarray(_P), x.shape))
-    return jnp.where(need[..., None], sub, x)
+def _p_bcast(ndim: int):
+    return jnp.asarray(_P).reshape((4,) + (1,) * ndim)
+
+
+def _cond_sub_p_limbs(limbs, force=None):
+    """Subtract p where x >= p (or where `force`); x < 2p assumed.
+
+    limbs: list of 4 arrays; returns a stacked (4, ...) array.
+    """
+    x = jnp.stack(limbs, axis=0)
+    need = _geq_p(limbs) if force is None else (force | _geq_p(limbs))
+    sub_, _ = _subv(x, _p_bcast(x.ndim - 1))
+    return jnp.where(need, jnp.stack(sub_, axis=0), x)
 
 
 # ---------------------------------------------------------------------------
@@ -165,35 +187,34 @@ def _cond_sub_p(x, force=None):
 def add(x, y):
     s, carry = _addv(x, y)
     # carry can only be set transiently for x + y >= 2^128 > p; value < 2p
-    # always, so carry implies s (mod 2^128) = x + y - 2^128 < p... but then
-    # we must add back 2^128 - p = c.  Equivalently: subtract p when
-    # carry || s >= p; with wrapping limbs, (s - p) mod 2^128 is correct in
-    # both cases.
-    return _cond_sub_p(s, force=carry.astype(bool))
+    # always, so with wrapping limbs, (s - p) mod 2^128 is correct in both
+    # the carry and the s >= p case.
+    return _cond_sub_p_limbs(s, force=carry.astype(bool))
 
 
 def sub(x, y):
     d, borrow = _subv(x, y)
-    addp, _ = _addv(d, jnp.broadcast_to(jnp.asarray(_P), d.shape))
-    return jnp.where(borrow.astype(bool)[..., None], addp, d)
+    ds = jnp.stack(d, axis=0)
+    addp, _ = _addv(ds, _p_bcast(ds.ndim - 1))
+    return jnp.where(borrow.astype(bool), jnp.stack(addp, axis=0), ds)
 
 
 def neg(x):
-    return sub(zeros(x.shape[:-1]), x)
+    return sub(zeros(x.shape[1:]), x)
 
 
 def mul(x, y):
     """CIOS Montgomery multiply: mont(a), mont(b) -> mont(a*b)."""
-    batch = x.shape[:-1]
+    batch = jnp.broadcast_shapes(x.shape[1:], y.shape[1:])
     zero = jnp.zeros(batch, dtype=_U32)
     t = [zero] * 5
     t5 = zero
     for i in range(4):
-        xi = x[..., i]
+        xi = x[i]
         # T += x_i * y
         carry = zero
         for j in range(4):
-            lo, hi = _mul32(xi, y[..., j])
+            lo, hi = _mul32(xi, y[j])
             s = t[j] + lo
             c1 = (s < lo).astype(_U32)
             s2 = s + carry
@@ -226,8 +247,7 @@ def mul(x, y):
         t5 = zero
     # value = t4 * 2^128 + t[0..3] < 2p: one wrapping subtract of p suffices
     # whenever t4 is set or t >= p.
-    res = jnp.stack(t[:4], axis=-1)
-    return _cond_sub_p(res, force=t[4].astype(bool))
+    return _cond_sub_p_limbs(t[:4], force=t[4].astype(bool))
 
 
 def square(x):
@@ -235,13 +255,12 @@ def square(x):
 
 
 def mul_const(x, value: int):
-    c = const(value)
-    return mul(x, jnp.broadcast_to(c, x.shape))
+    return mul(x, const(value))
 
 
 def pow_static(x, e: int):
     assert e >= 0
-    result = ones(x.shape[:-1])
+    result = ones(x.shape[1:])
     base = x
     while e:
         if e & 1:
@@ -256,21 +275,23 @@ def inv(x):
 
 
 def eq(x, y):
-    out = jnp.ones(x.shape[:-1], dtype=bool)
+    out = jnp.ones(jnp.broadcast_shapes(x.shape[1:], y.shape[1:]), dtype=bool)
     for i in range(4):
-        out = out & (x[..., i] == y[..., i])
+        out = out & (x[i] == y[i])
     return out
 
 
 def is_zero(x):
-    out = jnp.ones(x.shape[:-1], dtype=bool)
+    out = jnp.ones(x.shape[1:], dtype=bool)
     for i in range(4):
-        out = out & (x[..., i] == 0)
+        out = out & (x[i] == 0)
     return out
 
 
 def select(mask, x, y):
-    return jnp.where(mask[..., None], x, y)
+    """Elementwise select: mask has the logical (limbless) shape and
+    broadcasts (trailing-aligned) against the limb-leading arrays."""
+    return jnp.where(mask, x, y)
 
 
 # ---------------------------------------------------------------------------
@@ -280,14 +301,14 @@ def select(mask, x, y):
 
 def from_raw(x):
     """Standard-form limbs (e.g. XOF lanes, < p) -> Montgomery form."""
-    return mul(x, jnp.broadcast_to(jnp.asarray(_limbs(R2)), x.shape))
+    return mul(x, jnp.asarray(_limbs(R2)))
 
 
 def to_raw(x):
     """Montgomery form -> standard-form limbs (little-endian encoding order)."""
     one = np.zeros(4, dtype=np.uint32)
     one[0] = 1
-    return mul(x, jnp.broadcast_to(jnp.asarray(one), x.shape))
+    return mul(x, jnp.asarray(one))
 
 
 # ---------------------------------------------------------------------------
@@ -299,18 +320,18 @@ def sum_mod(x, axis: int = -1):
     if axis < 0:
         axis = x.ndim - 1 + axis
     assert 0 <= axis < x.ndim - 1
-    x = jnp.moveaxis(x, axis, 0)
-    n = x.shape[0]
+    x = jnp.moveaxis(x, axis + 1, 1)
+    n = x.shape[1]
     m = 1
     while m < n:
         m *= 2
     if m != n:
-        pad = jnp.zeros((m - n,) + x.shape[1:], dtype=x.dtype)
-        x = jnp.concatenate([x, pad], axis=0)
-    while x.shape[0] > 1:
-        half = x.shape[0] // 2
-        x = add(x[:half], x[half:])
-    return x[0]
+        pad = jnp.zeros(x.shape[:1] + (m - n,) + x.shape[2:], dtype=x.dtype)
+        x = jnp.concatenate([x, pad], axis=1)
+    while x.shape[1] > 1:
+        half = x.shape[1] // 2
+        x = add(x[:, :half], x[:, half:])
+    return x[:, 0]
 
 
 def dot(x, y, axis: int = -1):
@@ -318,18 +339,18 @@ def dot(x, y, axis: int = -1):
 
 
 def poly_eval(coeffs, x):
-    n = coeffs.shape[0]
-    acc = coeffs[n - 1]
+    n = coeffs.shape[1]
+    acc = coeffs[:, n - 1]
     for i in range(n - 2, -1, -1):
-        acc = add(mul(acc, x), coeffs[i])
+        acc = add(mul(acc, x), coeffs[:, i])
     return acc
 
 
 def powers(x, n: int):
-    out = [ones(x.shape[:-1])]
+    out = [ones(x.shape[1:])]
     for _ in range(n - 1):
         out.append(mul(out[-1], x))
-    return jnp.stack(out, axis=0)
+    return jnp.stack(out, axis=1)
 
 
 @functools.lru_cache(maxsize=None)
@@ -358,32 +379,42 @@ def _twiddles(n: int, inverse: bool) -> tuple:
 
 
 def _ntt_core(x, n: int, inverse: bool):
-    batch = x.shape[:-2]
-    x = x[..., _bitrev(n), :]
+    """x: [4, n, ...] — transform over device axis 1, any trailing shape."""
+    rest = x.shape[2:]
+    ones_ = (1,) * len(rest)
+    x = x[:, _bitrev(n)]
     for stage, tw in enumerate(_twiddles(n, inverse)):
         m = 2 << stage
         half = m // 2
-        xr = x.reshape(batch + (n // m, 2, half, 4))
-        u = xr[..., 0, :, :]
-        v = mul(xr[..., 1, :, :], jnp.asarray(tw))
-        out = jnp.stack([add(u, v), sub(u, v)], axis=-3)
-        x = out.reshape(batch + (n, 4))
+        xr = x.reshape((4, n // m, 2, half) + rest)
+        u = xr[:, :, 0]
+        twb = jnp.asarray(tw).reshape((4, 1, half) + ones_)
+        v = mul(xr[:, :, 1], twb)
+        out = jnp.stack([add(u, v), sub(u, v)], axis=2)
+        x = out.reshape((4, n) + rest)
     return x
 
 
-def ntt(coeffs, n: int | None = None):
-    k = coeffs.shape[-2]
+def _to_axis1(x, axis: int):
+    dev = (axis % (x.ndim - 1)) + 1
+    return jnp.moveaxis(x, dev, 1), dev
+
+
+def ntt(coeffs, n: int | None = None, axis: int = -1):
+    x, dev = _to_axis1(coeffs, axis)
+    k = x.shape[1]
     if n is None:
         n = k
     assert n & (n - 1) == 0 and k <= n
     if k < n:
-        pad = jnp.zeros(coeffs.shape[:-2] + (n - k, 4), dtype=coeffs.dtype)
-        coeffs = jnp.concatenate([coeffs, pad], axis=-2)
-    return _ntt_core(coeffs, n, inverse=False)
+        pad = jnp.zeros((4, n - k) + x.shape[2:], dtype=x.dtype)
+        x = jnp.concatenate([x, pad], axis=1)
+    return jnp.moveaxis(_ntt_core(x, n, inverse=False), 1, dev)
 
 
-def intt(evals):
-    n = evals.shape[-2]
+def intt(evals, axis: int = -1):
+    x, dev = _to_axis1(evals, axis)
+    n = x.shape[1]
     assert n & (n - 1) == 0
-    x = _ntt_core(evals, n, inverse=True)
-    return mul_const(x, pow(n, MODULUS - 2, MODULUS))
+    x = _ntt_core(x, n, inverse=True)
+    return jnp.moveaxis(mul_const(x, pow(n, MODULUS - 2, MODULUS)), 1, dev)

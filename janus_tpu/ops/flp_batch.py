@@ -4,8 +4,13 @@ This is the FLP `query`/`decide` pipeline (the per-report proof verification
 the reference runs sequentially inside prio — SURVEY.md §0, §2.8) recast as
 static-shape array programs over a report batch:
 
+- All field tensors use the limb-leading / batch-minor layout of
+  janus_tpu.ops.field64/field128: a logical [..., E] vector over N reports is
+  a uint32 array (LIMBS, ..., E, N).  The element axis sits at device axis
+  -2 and the report axis at -1, so every elementwise field op fills the TPU's
+  (8 sublanes, 128 lanes) register tiles with (elements, reports).
 - Circuit wire values are built by small per-circuit classes (Count, Sum,
-  SumVec, Histogram) as [..., calls, arity, L] limb arrays.
+  SumVec, Histogram) as [L, ..., calls, arity, N] limb arrays.
 - Wire polynomials are evaluated at the query point t **barycentrically**:
   p(t) = ((t^p2 - 1)/p2) * sum_i evals_i * w^i/(t - w^i).  The denominator
   vector is shared by every wire, so the whole [arity, p2] evaluation is one
@@ -48,10 +53,17 @@ def field_ops(field_cls):
     raise ValueError(f"no limb kernels for {field_cls}")
 
 
+def _cvec(f, values, trailing: int):
+    """Packed constant vector (L, k) with `trailing` singleton axes appended
+    so it broadcasts against (L, ..., k, N) arrays."""
+    c = jnp.asarray(f.pack(values))
+    return c.reshape(c.shape + (1,) * trailing)
+
+
 def _horner(f, coeffs, x, axis=-2):
     """Evaluate polynomials (coefficient axis `axis`, low order first) at x.
 
-    coeffs: [..., n, ..., L]; x broadcastable to the coefficient-slice shape.
+    coeffs: [L, ..., n, N]; x broadcastable to the coefficient-slice shape.
     lax.scan-rolled: one field multiply in the compiled graph.
     """
     c = jnp.moveaxis(coeffs, axis, 0)
@@ -65,13 +77,13 @@ def _horner(f, coeffs, x, axis=-2):
 
 
 def _chain_powers(f, r, n: int):
-    """[r^1, ..., r^n] stacked on a new axis before the limb axis (scan-rolled)."""
+    """[r^1, ..., r^n] stacked on a new element axis at -2 (scan-rolled)."""
 
     def body(acc, _):
         nxt = f.mul(acc, r)
         return nxt, nxt
 
-    _, out = jax.lax.scan(body, f.ones(r.shape[:-1]), None, length=n)
+    _, out = jax.lax.scan(body, f.ones(r.shape[1:]), None, length=n)
     return jnp.moveaxis(out, 0, -2)
 
 
@@ -86,10 +98,39 @@ def _inv_fermat(f, x):
 
     def body(acc, bit):
         acc = f.mul(acc, acc)
-        return f.select(jnp.broadcast_to(bit, acc.shape[:-1]), f.mul(acc, x), acc), None
+        return f.select(jnp.broadcast_to(bit, acc.shape[1:]), f.mul(acc, x), acc), None
 
-    acc, _ = jax.lax.scan(body, f.ones(x.shape[:-1]), bits)
+    acc, _ = jax.lax.scan(body, f.ones(x.shape[1:]), bits)
     return acc
+
+
+def _batch_inv(f, x, axis=-2):
+    """Invert every element along `axis` via Montgomery's trick: forward
+    prefix products, ONE Fermat ladder on the total, backward unwind —
+    3(n-1) multiplies plus one inversion instead of a ladder per element.
+
+    A zero element poisons the whole vector for that report (every returned
+    inverse is garbage, not just the zero's).  The only reachable zero is a
+    barycentric denominator on a bad_t-flagged lane, and flagged lanes are
+    recomputed on the host oracle, so the contract matches _inv_fermat's
+    inv(0) == 0 in effect: flagged-lane outputs are never consumed.
+    """
+    dev = axis % x.ndim
+    xs = jnp.moveaxis(x, dev, 0)  # (n, L, ...)
+    one = f.ones(xs.shape[2:])
+
+    def fwd(carry, xi):
+        return f.mul(carry, xi), carry  # carry-out excludes xi
+
+    total, excl = jax.lax.scan(fwd, one, xs)
+    tinv = _inv_fermat(f, total)
+
+    def bwd(carry, args):
+        xi, ei = args
+        return f.mul(carry, xi), f.mul(carry, ei)
+
+    _, invs = jax.lax.scan(bwd, tinv, (xs, excl), reverse=True)
+    return jnp.moveaxis(invs, 0, dev)
 
 
 # ---------------------------------------------------------------------------
@@ -105,21 +146,21 @@ class _BatchCircuit:
         self.f = fops
 
     def wires(self, meas, joint_rand, num_shares: int):
-        """-> gadget call inputs [..., calls, arity, L]."""
+        """-> gadget call inputs [L, ..., calls, arity, N]."""
         raise NotImplementedError
 
     def output(self, gadget_outs, meas, joint_rand, num_shares: int):
-        """Affine circuit output share given gadget outputs [..., calls, L]."""
+        """Affine circuit output share given gadget outputs [L, ..., calls, N]."""
         raise NotImplementedError
 
     def truncate(self, meas):
-        """[..., MEAS_LEN, L] -> [..., OUTPUT_LEN, L]."""
+        """[L, ..., MEAS_LEN, N] -> [L, ..., OUTPUT_LEN, N]."""
         raise NotImplementedError
 
 
 class _BatchCount(_BatchCircuit):
     def wires(self, meas, joint_rand, num_shares):
-        x = meas[..., 0:1, :]  # [..., 1, L]
+        x = meas[..., 0:1, :]  # [L, ..., 1, N]
         return jnp.stack([x, x], axis=-2)  # calls=1, arity=2
 
     def output(self, gadget_outs, meas, joint_rand, num_shares):
@@ -136,17 +177,17 @@ class _BatchSum(_BatchCircuit):
     def output(self, gadget_outs, meas, joint_rand, num_shares):
         f = self.f
         r = joint_rand[..., 0, :]
-        w = _chain_powers(f, r, gadget_outs.shape[-2])  # [..., bits, L]
-        return f.sum_mod(f.mul(w, gadget_outs), axis=-1)
+        w = _chain_powers(f, r, gadget_outs.shape[-2])  # [L, ..., bits, N]
+        return f.sum_mod(f.mul(w, gadget_outs), axis=-2)
 
     def truncate(self, meas):
         f = self.f
-        weights = f.pack([1 << i for i in range(self.valid.bits)])
-        return f.sum_mod(f.mul(meas, jnp.asarray(weights)), axis=-1)[..., None, :]
+        weights = _cvec(f, [1 << i for i in range(self.valid.bits)], 1)
+        return f.sum_mod(f.mul(meas, weights), axis=-2)[..., None, :]
 
 
 def _pad_chunks(elems, calls: int, chunk: int):
-    """Pad the element axis to calls*chunk and reshape to [..., calls, chunk, L]."""
+    """Pad the element axis to calls*chunk and reshape to [L, ..., calls, chunk, N]."""
     n = elems.shape[-2]
     pad = calls * chunk - n
     if pad:
@@ -159,13 +200,13 @@ def _range_check_wires(f, elems, joint_rand, num_shares: int, calls: int,
                        chunk: int):
     """ParallelSum(Mul, chunk) range-check wires over an element vector:
     per call, interleaved [r^(j+1)*e_j, e_j - 1/num_shares] pairs."""
-    chunks = _pad_chunks(elems, calls, chunk)  # [..., calls, chunk, L]
-    r = joint_rand[..., :calls, :]  # [..., calls, L]
+    chunks = _pad_chunks(elems, calls, chunk)  # [L, ..., calls, chunk, N]
+    r = joint_rand[..., :calls, :]  # [L, ..., calls, N]
     rpow = _chain_powers(f, r, chunk)  # r^1..r^chunk
     u = f.mul(rpow, chunks)
     shares_inv = f.const(pow(num_shares, f.MODULUS - 2, f.MODULUS))
-    vwire = f.sub(chunks, jnp.broadcast_to(shares_inv, chunks.shape))
-    inter = jnp.stack([u, vwire], axis=-2)  # [..., calls, chunk, 2, L]
+    vwire = f.sub(chunks, shares_inv)
+    inter = jnp.stack([u, vwire], axis=-2)  # [L, ..., calls, chunk, 2, N]
     return inter.reshape(inter.shape[:-3] + (2 * chunk, inter.shape[-1]))
 
 
@@ -180,25 +221,23 @@ class _BatchChunked(_BatchCircuit):
 
 class _BatchSumVec(_BatchChunked):
     def output(self, gadget_outs, meas, joint_rand, num_shares):
-        return self.f.sum_mod(gadget_outs, axis=-1)
+        return self.f.sum_mod(gadget_outs, axis=-2)
 
     def truncate(self, meas):
         f = self.f
         v = self.valid
         m = meas.reshape(meas.shape[:-2] + (v.length, v.bits, meas.shape[-1]))
-        weights = jnp.asarray(f.pack([1 << i for i in range(v.bits)]))
-        return f.sum_mod(f.mul(m, weights), axis=-1)
+        weights = _cvec(f, [1 << i for i in range(v.bits)], 1)
+        return f.sum_mod(f.mul(m, weights), axis=-2)
 
 
 class _BatchHistogram(_BatchChunked):
     def output(self, gadget_outs, meas, joint_rand, num_shares):
         f = self.f
         v = self.valid
-        range_check = f.sum_mod(gadget_outs, axis=-1)
+        range_check = f.sum_mod(gadget_outs, axis=-2)
         shares_inv = f.const(pow(num_shares, f.MODULUS - 2, f.MODULUS))
-        sum_check = f.sub(
-            f.sum_mod(meas, axis=-1), jnp.broadcast_to(shares_inv, range_check.shape)
-        )
+        sum_check = f.sub(f.sum_mod(meas, axis=-2), shares_inv)
         return f.add(range_check, f.mul(joint_rand[..., v._calls, :], sum_check))
 
     def truncate(self, meas):
@@ -214,8 +253,8 @@ class _BatchFixedPoint(_BatchCircuit):
         v = self.valid
         ent = meas[..., : v.length * v.bits, :]
         ent = ent.reshape(ent.shape[:-2] + (v.length, v.bits, ent.shape[-1]))
-        weights = jnp.asarray(f.pack([1 << i for i in range(v.bits)]))
-        return f.sum_mod(f.mul(ent, weights), axis=-1)  # [..., length, L]
+        weights = _cvec(f, [1 << i for i in range(v.bits)], 1)
+        return f.sum_mod(f.mul(ent, weights), axis=-2)  # [L, ..., length, N]
 
     def wires(self, meas, joint_rand, num_shares):
         v = self.valid
@@ -224,7 +263,7 @@ class _BatchFixedPoint(_BatchCircuit):
                                        v._calls_bits, chunk)
         # square wires: (v_i, v_i) pairs through the same gadget
         vals = _pad_chunks(self._entry_values(meas), v._calls_sq, chunk)
-        sq = jnp.stack([vals, vals], axis=-2)  # [..., cs, chunk, 2, L]
+        sq = jnp.stack([vals, vals], axis=-2)  # [L, ..., cs, chunk, 2, N]
         sq_wires = sq.reshape(sq.shape[:-3] + (2 * chunk, sq.shape[-1]))
         return jnp.concatenate([bit_wires, sq_wires], axis=-3)
 
@@ -232,18 +271,17 @@ class _BatchFixedPoint(_BatchCircuit):
         f = self.f
         v = self.valid
         cb = v._calls_bits
-        range_check = f.sum_mod(gadget_outs[..., :cb, :], axis=-1)
-        sq_sum = f.sum_mod(gadget_outs[..., cb:, :], axis=-1)
+        range_check = f.sum_mod(gadget_outs[..., :cb, :], axis=-2)
+        sq_sum = f.sum_mod(gadget_outs[..., cb:, :], axis=-2)
         vals = self._entry_values(meas)
-        lin = f.sum_mod(vals, axis=-1)
+        lin = f.sum_mod(vals, axis=-2)
         norm_bits = meas[..., v.length * v.bits :, :]
-        nweights = jnp.asarray(f.pack([1 << i for i in range(v.bits_for_norm)]))
-        claimed = f.sum_mod(f.mul(norm_bits, nweights), axis=-1)
+        nweights = _cvec(f, [1 << i for i in range(v.bits_for_norm)], 1)
+        claimed = f.sum_mod(f.mul(norm_bits, nweights), axis=-2)
         shares_inv = pow(num_shares, f.MODULUS - 2, f.MODULUS)
         offset = f.const(
             shares_inv * ((v.length << (2 * v.bits - 2)) % f.MODULUS) % f.MODULUS)
-        computed = f.add(f.sub(sq_sum, f.mul_const(lin, 1 << v.bits)),
-                         jnp.broadcast_to(offset, sq_sum.shape))
+        computed = f.add(f.sub(sq_sum, f.mul_const(lin, 1 << v.bits)), offset)
         norm_diff = f.sub(claimed, computed)
         return f.add(range_check, f.mul(joint_rand[..., cb, :], norm_diff))
 
@@ -284,7 +322,7 @@ class BatchFlp:
     def _gadget_outs(self, coeffs):
         """Gadget poly values at alpha^(k+1), k < calls: fold + forward NTT.
 
-        coeffs: [..., ncoeffs, L] -> [..., calls, L]
+        coeffs: [L, ..., ncoeffs, N] -> [L, ..., calls, N]
         """
         f = self.f
         p2 = self.p2
@@ -293,26 +331,26 @@ class BatchFlp:
             z = jnp.zeros(coeffs.shape[:-2] + (pad, coeffs.shape[-1]), dtype=coeffs.dtype)
             coeffs = jnp.concatenate([coeffs, z], axis=-2)
         folded = coeffs.reshape(coeffs.shape[:-2] + (-1, p2, coeffs.shape[-1]))
-        folded = f.sum_mod(folded, axis=-2)  # sum chunks: x^p2 == 1 on the subgroup
-        evals = f.ntt(folded)  # [..., p2, L] at w^j, natural order
+        folded = f.sum_mod(folded, axis=-3)  # sum chunks: x^p2 == 1 on the subgroup
+        evals = f.ntt(folded, axis=-2)  # [L, ..., p2, N] at w^j, natural order
         return evals[..., 1 : self.calls + 1, :]
 
     def _gadget_eval(self, wires):
-        """Direct gadget evaluation on wire values [..., arity, L] -> [..., L]."""
+        """Direct gadget evaluation on wire values [L, ..., arity, N] -> [L, ..., N]."""
         f = self.f
         g = self.gadget
         if isinstance(g, _flp.Mul):
             return f.mul(wires[..., 0, :], wires[..., 1, :])
         if isinstance(g, _flp.PolyEval):
-            coeffs = jnp.asarray(f.pack(g.coeffs))  # [n, L]
+            coeffs = jnp.asarray(f.pack(g.coeffs))  # [L, n]
             x = wires[..., 0, :]
-            acc = jnp.broadcast_to(coeffs[-1], x.shape)
+            acc = f.add(f.zeros(x.shape[1:]), coeffs[:, -1])
             for i in range(len(g.coeffs) - 2, -1, -1):
-                acc = f.add(f.mul(acc, x), jnp.broadcast_to(coeffs[i], x.shape))
+                acc = f.add(f.mul(acc, x), coeffs[:, i])
             return acc
         if isinstance(g, _flp.ParallelSum) and isinstance(g.subgadget, _flp.Mul):
             pairs = wires.reshape(wires.shape[:-2] + (g.count, 2, wires.shape[-1]))
-            return f.sum_mod(f.mul(pairs[..., 0, :], pairs[..., 1, :]), axis=-1)
+            return f.sum_mod(f.mul(pairs[..., 0, :], pairs[..., 1, :]), axis=-2)
         raise NotImplementedError(type(g))
 
     # -- query / decide --------------------------------------------------
@@ -320,10 +358,10 @@ class BatchFlp:
     def query(self, meas_share, proof_share, query_rand, joint_rand, num_shares: int):
         """Batched flp.query.
 
-        meas_share [..., MEAS_LEN, L], proof_share [..., PROOF_LEN, L],
-        query_rand [..., 1, L], joint_rand [..., JOINT_RAND_LEN, L] (all in
-        the field module's internal form) ->
-        (verifier [..., VERIFIER_LEN, L], bad_t [...] bool).
+        meas_share [L, ..., MEAS_LEN, N], proof_share [L, ..., PROOF_LEN, N],
+        query_rand [L, ..., 1, N], joint_rand [L, ..., JOINT_RAND_LEN, N]
+        (all in the field module's internal form) ->
+        (verifier [L, ..., VERIFIER_LEN, N], bad_t [..., N] bool).
         """
         f = self.f
         A, m, p2 = self.arity, self.calls, self.p2
@@ -331,37 +369,39 @@ class BatchFlp:
         coeffs = proof_share[..., A : A + self.ncoeffs, :]
         t = query_rand[..., 0, :]
 
-        wires = self.circuit.wires(meas_share, joint_rand, num_shares)  # [..., m, A, L]
-        gouts = self._gadget_outs(coeffs)  # [..., m, L]
+        wires = self.circuit.wires(meas_share, joint_rand, num_shares)  # [L, ..., m, A, N]
+        gouts = self._gadget_outs(coeffs)  # [L, ..., m, N]
         v0 = self.circuit.output(gouts, meas_share, joint_rand, num_shares)
 
         # wire polynomials evaluated at t, barycentrically over the
-        # p2-subgroup: evals are [seed_w, wire values..., 0...] at w^0..w^(p2-1).
-        wires_t = jnp.swapaxes(wires, -3, -2)  # [..., A, m, L]
-        zpad = jnp.zeros(wires_t.shape[:-2] + (p2 - 1 - m, wires_t.shape[-1]),
-                         dtype=wires_t.dtype)
-        evals = jnp.concatenate([seeds[..., :, None, :], wires_t, zpad], axis=-2)
+        # p2-subgroup: wire a's evaluations are [seed_a at w^0, wire values
+        # at w^1..w^m, 0 at the rest], so the barycentric sum needs only the
+        # first m+1 denominator terms — the zero lanes are never materialized
+        # (the dominant [.., m, A, N] tensor is the compile-memory ceiling
+        # for big circuits like SumVec-1000).
         w_int = pow(f.GENERATOR, f.GEN_ORDER // p2, f.MODULUS)
-        w_pows = jnp.asarray(f.pack([pow(w_int, i, f.MODULUS) for i in range(p2)]))
-        denom = f.sub(jnp.broadcast_to(t[..., None, :], t.shape[:-1] + (p2, t.shape[-1])),
-                      jnp.broadcast_to(w_pows, t.shape[:-1] + (p2, t.shape[-1])))
-        d = f.mul(jnp.broadcast_to(w_pows, denom.shape), _inv_fermat(f, denom))
+        w_pows = _cvec(f, [pow(w_int, i, f.MODULUS) for i in range(p2)], 1)
+        denom = f.sub(t[..., None, :], w_pows)  # [L, ..., p2, N]
+        d = f.mul(w_pows, _batch_inv(f, denom))
         # scale = (t^p2 - 1) / p2
-        scale = f.mul_const(f.sub(f.pow_static(t, p2), f.ones(t.shape[:-1])),
+        scale = f.mul_const(f.sub(f.pow_static(t, p2), f.ones(t.shape[1:])),
                             pow(p2, f.MODULUS - 2, f.MODULUS))
-        sums = f.sum_mod(f.mul(evals, d[..., None, :, :]), axis=-1)  # [..., A, L]
+        seed_term = f.mul(seeds, d[..., 0:1, :])  # [L, ..., A, N]
+        wire_term = f.sum_mod(
+            f.mul(wires, d[..., 1 : m + 1, None, :]), axis=-3)  # over the m axis
+        sums = f.add(seed_term, wire_term)
         wire_at_t = f.mul(sums, scale[..., None, :])
 
-        gpoly_at_t = _horner(f, coeffs, t, axis=-2)  # [..., L]
+        gpoly_at_t = _horner(f, coeffs, t, axis=-2)  # [L, ..., N]
 
         verifier = jnp.concatenate(
             [v0[..., None, :], wire_at_t, gpoly_at_t[..., None, :]], axis=-2
         )
-        bad_t = f.eq(f.pow_static(t, p2), f.ones(t.shape[:-1]))
+        bad_t = f.eq(f.pow_static(t, p2), f.ones(t.shape[1:]))
         return verifier, bad_t
 
     def decide(self, verifier):
-        """Batched flp.decide: [..., VERIFIER_LEN, L] -> ok [...] bool."""
+        """Batched flp.decide: [L, ..., VERIFIER_LEN, N] -> ok [..., N] bool."""
         f = self.f
         A = self.arity
         v0 = verifier[..., 0, :]

@@ -318,11 +318,16 @@ _P64 = (1 << 64) - (1 << 32) + 1
 
 def expand_field64(batch_shape: tuple, seed, msg_parts, n: int):
     """Sample n Field64 elements per report (speculative rejection sampling,
-    same contract as xof_batch.expand_field64)."""
+    same contract as xof_batch.expand_field64: raw limbs (2, n) + batch)."""
+    bn = len(batch_shape)
     stream = xof_stream(batch_shape, seed, msg_parts, 8 * n)
     le = stream.reshape(batch_shape + (n, 2, 4)).astype(_U32)
     limbs = (le[..., 0] | (le[..., 1] << _U32(8))
              | (le[..., 2] << _U32(16)) | (le[..., 3] << _U32(24)))
-    lo, hi = limbs[..., 0], limbs[..., 1]
+    lo, hi = limbs[..., 0], limbs[..., 1]  # each batch + (n,)
     bad = (hi == _U32(0xFFFFFFFF)) & (lo >= _U32(1))
-    return limbs, jnp.any(bad, axis=-1)
+    reject = jnp.any(bad, axis=-1)
+    # -> the engine's limb-leading / batch-minor layout
+    perm = (bn,) + tuple(range(bn))
+    out = jnp.stack([jnp.transpose(lo, perm), jnp.transpose(hi, perm)], axis=0)
+    return out, reject

@@ -7,11 +7,14 @@ share expansion, and query-randomness stream is a TurboSHAKE128 sponge
 runs the permutation across an arbitrary batch of states at once.
 
 Design notes (TPU/XLA-first):
-- A state is a uint32 array of shape [..., 25, 2] ([..., i, 0] = low 32 bits
-  of lane i).  The round body is ~20 *vector* ops over the lane axis (theta as
+- A state is a PAIR of uint32 arrays (lo, hi), each of shape (25,) + batch
+  ([i] = low/high 32 bits of Keccak lane i).  The Keccak lane axis LEADS and
+  the report batch is the MINOR axis: TPU vector registers are (8 sublanes,
+  128 lanes) tiles over the two minor dims, so the batch axis fills every
+  lane; a trailing (25, 2) layout would leave the 128-lane dimension 2/128
+  occupied.  The round body is ~20 *vector* ops over the lane axis (theta as
   an XOR-reduction + roll, rho as per-lane tensor shifts, pi as one static
-  gather, chi as rolls) — not 3600 scalar ops; an unrolled scalar formulation
-  sent XLA:CPU compile time past 3 minutes.
+  gather, chi as rolls) — not 3600 scalar ops.
 - Rounds run under lax.scan with the round constants as the scanned operand:
   one compiled body regardless of 12 vs 24 rounds.
 - Keccak lanes are little-endian u64, so a canonical Field64 limb pair
@@ -45,13 +48,14 @@ _RC_LIMBS = np.array(
     [[rc & 0xFFFFFFFF, rc >> 32] for rc in ROUND_CONSTANTS], dtype=np.uint32
 )
 
-# per-lane rho rotations, applied post-pi-gather would differ; we rotate at rho
-# time with the offsets in source-lane order.
+# per-lane rho rotations, applied at rho time with offsets in source-lane order.
 _RHO = np.array(ROTATION_OFFSETS, dtype=np.uint32)
 
 
 def _rotl_by(lo, hi, n):
-    """Rotate-left (lo, hi) u64 lanes by per-lane amounts n (uint32 array, 0..63)."""
+    """Rotate-left (lo, hi) u64 lanes by per-lane amounts n (uint32, 0..63).
+
+    n broadcasts against the LEADING lane axis (shape (25,) + (1,)*batch)."""
     swap = (n & 32).astype(bool)
     r = n & 31
     a = jnp.where(swap, hi, lo)
@@ -65,81 +69,90 @@ def _rotl_by(lo, hi, n):
     return (a << r) | carry_b, (b << r) | carry_a
 
 
-def _round(state, rc):
-    """One Keccak round on [..., 25, 2]; rc is a (2,) uint32 limb pair."""
-    lo, hi = state[..., 0], state[..., 1]  # [..., 25]
-    sh = lo.shape[:-1]
-    lo5 = lo.reshape(sh + (5, 5))  # [..., y, x]
-    hi5 = hi.reshape(sh + (5, 5))
+def _round(lo, hi, rc):
+    """One Keccak round on ((25,)+batch, (25,)+batch); rc is a (2,) pair."""
+    batch = lo.shape[1:]
+    ones_ = (1,) * len(batch)
+    lo5 = lo.reshape((5, 5) + batch)  # [y, x, ...]
+    hi5 = hi.reshape((5, 5) + batch)
     # theta
-    clo = jax.lax.reduce(lo5, _U32(0), jax.lax.bitwise_xor, [lo5.ndim - 2])  # [..., x]
-    chi = jax.lax.reduce(hi5, _U32(0), jax.lax.bitwise_xor, [hi5.ndim - 2])
-    rlo, rhi = _rotl_by(jnp.roll(clo, -1, axis=-1), jnp.roll(chi, -1, axis=-1), _U32(1))
-    dlo = jnp.roll(clo, 1, axis=-1) ^ rlo
-    dhi = jnp.roll(chi, 1, axis=-1) ^ rhi
-    lo5 = lo5 ^ dlo[..., None, :]
-    hi5 = hi5 ^ dhi[..., None, :]
-    lo = lo5.reshape(sh + (25,))
-    hi = hi5.reshape(sh + (25,))
-    # rho (per-lane static rotation) then pi (static gather)
-    lo, hi = _rotl_by(lo, hi, jnp.asarray(_RHO))
-    lo = lo[..., _PI_SRC]
-    hi = hi[..., _PI_SRC]
+    clo = jax.lax.reduce(lo5, _U32(0), jax.lax.bitwise_xor, [0])  # [x, ...]
+    chi = jax.lax.reduce(hi5, _U32(0), jax.lax.bitwise_xor, [0])
+    rlo, rhi = _rotl_by(jnp.roll(clo, -1, axis=0), jnp.roll(chi, -1, axis=0), _U32(1))
+    dlo = jnp.roll(clo, 1, axis=0) ^ rlo
+    dhi = jnp.roll(chi, 1, axis=0) ^ rhi
+    lo5 = lo5 ^ dlo[None]
+    hi5 = hi5 ^ dhi[None]
+    lo = lo5.reshape((25,) + batch)
+    hi = hi5.reshape((25,) + batch)
+    # rho (per-lane static rotation) then pi (static gather on the lane axis)
+    lo, hi = _rotl_by(lo, hi, jnp.asarray(_RHO).reshape((25,) + ones_))
+    lo = lo[_PI_SRC]
+    hi = hi[_PI_SRC]
     # chi: a[x] = b[x] ^ (~b[x+1] & b[x+2]) along the x axis
-    lo5 = lo.reshape(sh + (5, 5))
-    hi5 = hi.reshape(sh + (5, 5))
-    lo5 = lo5 ^ (~jnp.roll(lo5, -1, axis=-1) & jnp.roll(lo5, -2, axis=-1))
-    hi5 = hi5 ^ (~jnp.roll(hi5, -1, axis=-1) & jnp.roll(hi5, -2, axis=-1))
-    lo = lo5.reshape(sh + (25,))
-    hi = hi5.reshape(sh + (25,))
+    lo5 = lo.reshape((5, 5) + batch)
+    hi5 = hi.reshape((5, 5) + batch)
+    lo5 = lo5 ^ (~jnp.roll(lo5, -1, axis=1) & jnp.roll(lo5, -2, axis=1))
+    hi5 = hi5 ^ (~jnp.roll(hi5, -1, axis=1) & jnp.roll(hi5, -2, axis=1))
+    lo = lo5.reshape((25,) + batch)
+    hi = hi5.reshape((25,) + batch)
     # iota
-    lo = lo.at[..., 0].set(lo[..., 0] ^ rc[0])
-    hi = hi.at[..., 0].set(hi[..., 0] ^ rc[1])
-    return jnp.stack([lo, hi], axis=-1)
+    lo = lo.at[0].set(lo[0] ^ rc[0])
+    hi = hi.at[0].set(hi[0] ^ rc[1])
+    return lo, hi
 
 
 def permute(state, rounds: int = 12):
-    """Keccak-p[1600, rounds] on a batch of states [..., 25, 2] (last rounds of f[1600])."""
+    """Keccak-p[1600, rounds] on a batch of states ((25,)+b, (25,)+b) pairs
+    (the last `rounds` rounds of Keccak-f[1600])."""
     assert 1 <= rounds <= 24, "Keccak-p[1600] round count must be in [1, 24]"
-    rcs = jnp.asarray(_RC_LIMBS[24 - rounds :])
+    rcs = jnp.asarray(_RC_LIMBS[24 - rounds:])
 
     def step(st, rc):
-        return _round(st, rc), None
+        return _round(st[0], st[1], rc), None
 
     state, _ = jax.lax.scan(step, state, rcs)
     return state
 
 
-def absorb(blocks, rounds: int = 12):
-    """Absorb pre-padded rate-lane blocks: [..., nblocks, 21, 2] -> state [..., 25, 2].
+def zero_state(batch_shape: tuple):
+    z = jnp.zeros((25,) + tuple(batch_shape), dtype=_U32)
+    return z, z
 
-    Uses lax.scan over the block axis so long messages (e.g. joint-rand binders
-    over encoded measurement shares) compile to a single rolled loop.
+
+def _xor_block(state, block):
+    """XOR a 21-lane block pair into the first 21 lanes of the state pair."""
+    lo, hi = state
+    blo, bhi = block
+    return lo.at[:RATE_LANES].set(lo[:RATE_LANES] ^ blo), \
+        hi.at[:RATE_LANES].set(hi[:RATE_LANES] ^ bhi)
+
+
+def absorb(blocks, rounds: int = 12):
+    """Absorb pre-padded rate-lane blocks.
+
+    blocks: pair of uint32 arrays (lo, hi), each [nblocks, 21, *batch].
+    Returns the state pair ((25,)+batch each).  Uses lax.scan over the block
+    axis so long messages (e.g. joint-rand binders over encoded measurement
+    shares) compile to a single rolled loop.
     """
-    batch_shape = blocks.shape[:-3]
-    nblocks = blocks.shape[-3]
-    state = jnp.zeros(batch_shape + (25, 2), dtype=_U32)
+    blo, bhi = blocks
+    nblocks = blo.shape[0]
+    state = zero_state(blo.shape[2:])
     if nblocks == 1:
         # common case (short messages): avoid scan overhead
-        return permute(_xor_block(state, blocks[..., 0, :, :]), rounds)
+        return permute(_xor_block(state, (blo[0], bhi[0])), rounds)
 
     def step(st, blk):
         return permute(_xor_block(st, blk), rounds), None
 
-    # move block axis to front for scan
-    blocks_t = jnp.moveaxis(blocks, -3, 0)
-    state, _ = jax.lax.scan(step, state, blocks_t)
+    state, _ = jax.lax.scan(step, state, (blo, bhi))
     return state
 
 
-def _xor_block(state, block):
-    """XOR a 21-lane block into the first 21 lanes of the state."""
-    pad = jnp.zeros(block.shape[:-2] + (25 - RATE_LANES, 2), dtype=_U32)
-    return state ^ jnp.concatenate([block, pad], axis=-2)
-
-
 def squeeze(state, n_lanes: int, rounds: int = 12):
-    """Squeeze n_lanes 64-bit lanes: returns ([..., n_lanes, 2], next_state).
+    """Squeeze n_lanes 64-bit lanes: returns ((lo, hi) each [n_lanes, *batch],
+    next_state).
 
     n_lanes is static; output lanes are the rate lanes of successive states.
     next_state is advanced past the last (fully or partially) consumed block,
@@ -148,20 +161,24 @@ def squeeze(state, n_lanes: int, rounds: int = 12):
     callers needing exact byte-stream resumption must track their own offset
     (the vdaf XOF layer squeezes whole streams in one call).
     """
-    outs = []
+    los, his = [], []
     remaining = n_lanes
     while True:
         take = min(remaining, RATE_LANES)
-        outs.append(state[..., :take, :])
+        los.append(state[0][:take])
+        his.append(state[1][:take])
         remaining -= take
         state = permute(state, rounds)
         if remaining == 0:
             break
-    return jnp.concatenate(outs, axis=-2) if len(outs) > 1 else outs[0], state
+    if len(los) > 1:
+        return (jnp.concatenate(los, axis=0), jnp.concatenate(his, axis=0)), state
+    return (los[0], his[0]), state
 
 
 def pad_message_to_blocks(message: bytes, domain: int):
-    """Host-side: byte message -> padded rate-lane blocks [nblocks, 21, 2] (numpy).
+    """Host-side: byte message -> padded rate-lane block pair
+    ((lo, hi) each [nblocks, 21] numpy).
 
     Applies the TurboSHAKE byte-aligned pad10*1 (domain byte carries the first
     pad bit).  For device-resident message content, the vdaf layer builds the
@@ -174,9 +191,12 @@ def pad_message_to_blocks(message: bytes, domain: int):
         p.extend(b"\x00" * (RATE_BYTES - len(p) % RATE_BYTES))
     p[-1] ^= 0x80
     nblocks = len(p) // RATE_BYTES
-    return np.frombuffer(bytes(p), dtype="<u4").reshape(nblocks, RATE_LANES, 2).copy()
+    lanes = np.frombuffer(bytes(p), dtype="<u4").reshape(nblocks, RATE_LANES, 2)
+    return lanes[..., 0].copy(), lanes[..., 1].copy()
 
 
 def lanes_to_bytes(lanes) -> bytes:
-    """Host-side: [n_lanes, 2] uint32 -> little-endian byte string."""
-    return np.ascontiguousarray(np.asarray(lanes), dtype="<u4").tobytes()
+    """Host-side: (lo, hi) pair of [n_lanes] uint32 -> little-endian bytes."""
+    lo, hi = (np.asarray(x) for x in lanes)
+    out = np.stack([lo, hi], axis=-1)
+    return np.ascontiguousarray(out, dtype="<u4").tobytes()

@@ -5,17 +5,21 @@ mirroring the XOF the reference consumes from prio 0.16 — core/src/vdaf.rs:16;
 SURVEY.md §2.8, §3.2).  Where the oracle runs one sponge per report, these
 functions run the sponge across a whole report batch at once:
 
-- Messages are assembled as uint8 arrays (static prefix bytes broadcast over
-  the batch, dynamic per-report parts concatenated), padded with the
-  TurboSHAKE domain byte, and bitcast to the 64-bit lane-pair layout of
-  janus_tpu.ops.keccak (bitcast is little-endian on every XLA backend, which
-  is exactly Keccak's byte order).
+- Messages are assembled as uint8 arrays in wire order (static prefix bytes
+  broadcast over the batch, dynamic per-report parts concatenated), padded
+  with the TurboSHAKE domain byte, bitcast to 64-bit lane pairs (bitcast is
+  little-endian on every XLA backend, which is exactly Keccak's byte order),
+  then transposed ONCE into the sponge's batch-minor layout
+  (janus_tpu.ops.keccak): all per-round work then runs with the report axis
+  on the 128-lane dimension of the TPU vector registers.
 - Field-element sampling is *speculative* rejection sampling: we squeeze
   exactly `n` candidates and return a per-report `reject` flag that is set iff
   any candidate fell outside the field (probability ≈ 2^-32 per Field64
   element, ≈ 2^-61 per Field128 element).  Flagged reports are recomputed on
   the host oracle; unflagged outputs are bit-identical to the oracle, since a
   rejection-free stream reads candidate i at offset i.
+- Sampled elements come back as RAW limb arrays in the field modules' leading-
+  limb / minor-batch layout: (LIMBS, n) + batch_shape.
 
 All shapes are static; everything is jit/vmap/shard-friendly.
 """
@@ -27,7 +31,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from janus_tpu.ops import keccak
-from janus_tpu.ops.field64 import MODULUS as P64
 from janus_tpu.vdaf.xof import TURBOSHAKE_DOMAIN
 
 _U8 = jnp.uint8
@@ -56,7 +59,8 @@ def build_blocks(batch_shape: tuple, parts, domain: int = TURBOSHAKE_DOMAIN):
 
     `parts` is a list of message segments in order; each is either static
     `bytes` (identical for every report, broadcast) or a uint8 array of shape
-    batch_shape + (k,).  Returns uint32 blocks [*batch_shape, nblocks, 21, 2].
+    batch_shape + (k,).  Returns the keccak block pair (lo, hi), each
+    uint32 [nblocks, 21, *batch_shape] (batch minor).
     """
     segs = []
     total = 0
@@ -82,22 +86,46 @@ def build_blocks(batch_shape: tuple, parts, domain: int = TURBOSHAKE_DOMAIN):
                                  batch_shape + (len(tail),)))
     msg = jnp.concatenate(segs, axis=-1)
     nblocks = msg.shape[-1] // RATE_BYTES
+    bn = len(batch_shape)
     lanes = jax.lax.bitcast_convert_type(
         msg.reshape(batch_shape + (nblocks, RATE_LANES, 2, 4)), _U32
-    )
-    return lanes
+    )  # batch + (nblocks, 21, 2)
+    # one transpose into the sponge's batch-minor layout
+    perm = (bn, bn + 1, bn + 2) + tuple(range(bn))
+    lanes = jnp.transpose(lanes, perm)  # (nblocks, 21, 2) + batch
+    return lanes[:, :, 0], lanes[:, :, 1]
+
+
+def lanes_to_u8_rows(lanes):
+    """Sponge output pair ((k,)+batch lo, hi) -> uint8 rows batch+(8k,)."""
+    lo, hi = lanes
+    k = lo.shape[0]
+    batch = lo.shape[1:]
+    bn = len(batch)
+    st = jnp.stack([lo, hi], axis=1)  # (k, 2) + batch
+    st = jnp.transpose(st, tuple(range(2, 2 + bn)) + (0, 1))  # batch + (k, 2)
+    b = jax.lax.bitcast_convert_type(st, _U8)  # batch + (k, 2, 4)
+    return b.reshape(batch + (8 * k,))
 
 
 def limbs_to_bytes(x):
-    """Field limb array [..., L] uint32 -> little-endian uint8 [..., 4L]."""
-    b = jax.lax.bitcast_convert_type(x, _U8)  # [..., L, 4]
-    return b.reshape(x.shape[:-1] + (4 * x.shape[-1],))
+    """Field limb array (L,) + S (batch anywhere in S) -> uint8 S + (4L,)
+    little-endian per element."""
+    L = x.shape[0]
+    xs = jnp.moveaxis(x, 0, -1)  # S + (L,)
+    b = jax.lax.bitcast_convert_type(xs, _U8)  # S + (L, 4)
+    return b.reshape(xs.shape[:-1] + (4 * L,))
 
 
 def vec_limbs_to_bytes(x):
-    """Field vector [..., n, L] uint32 -> encoded bytes [..., n*4L] uint8."""
-    b = jax.lax.bitcast_convert_type(x, _U8)  # [..., n, L, 4]
-    return b.reshape(x.shape[:-2] + (x.shape[-2] * 4 * x.shape[-1],))
+    """Raw field vector (L, n) + batch -> encoded bytes batch + (n*4L,) uint8
+    (the wire encoding order: element-major, limb little-endian)."""
+    L, n = x.shape[0], x.shape[1]
+    batch = x.shape[2:]
+    bn = len(batch)
+    xs = jnp.transpose(x, tuple(range(2, 2 + bn)) + (1, 0))  # batch + (n, L)
+    b = jax.lax.bitcast_convert_type(xs, _U8)  # batch + (n, L, 4)
+    return b.reshape(batch + (n * 4 * L,))
 
 
 # ---------------------------------------------------------------------------
@@ -106,7 +134,7 @@ def vec_limbs_to_bytes(x):
 
 
 def _squeeze_lanes(blocks, n_lanes: int):
-    """Absorb blocks and squeeze n_lanes: -> [..., n_lanes, 2] uint32."""
+    """Absorb blocks and squeeze n_lanes: -> pair ((n_lanes,)+batch lo, hi)."""
     state = keccak.absorb(blocks)
     lanes, _ = keccak.squeeze(state, n_lanes)
     return lanes
@@ -116,21 +144,20 @@ def derive_seed(batch_shape: tuple, parts, seed_size: int = 16):
     """Batched XofTurboShake128 derive_seed: -> uint8 [*batch_shape, seed_size]."""
     assert seed_size % 8 == 0
     lanes = _squeeze_lanes(build_blocks(batch_shape, parts), seed_size // 8)
-    return jax.lax.bitcast_convert_type(lanes, _U8).reshape(batch_shape + (seed_size,))
+    return lanes_to_u8_rows(lanes)
 
 
 def expand_field64(batch_shape: tuple, parts, n: int):
     """Sample n Field64 elements per report.
 
-    Returns (elems [*batch_shape, n, 2] uint32, reject [*batch_shape] bool).
+    Returns (elems (2, n) + batch_shape uint32 raw limbs, reject [*batch]).
     Where reject is False the elements equal the oracle's rejection-sampled
     stream exactly; where True the values are unusable (host fallback).
     """
-    lanes = _squeeze_lanes(build_blocks(batch_shape, parts), n)
-    lo, hi = lanes[..., 0], lanes[..., 1]
+    lo, hi = _squeeze_lanes(build_blocks(batch_shape, parts), n)
     # candidate >= p  <=>  hi == 2^32 - 1 and lo >= 1 (p = 2^64 - 2^32 + 1)
     bad = (hi == _U32(0xFFFFFFFF)) & (lo >= _U32(1))
-    return lanes, jnp.any(bad, axis=-1)
+    return jnp.stack([lo, hi], axis=0), jnp.any(bad, axis=0)
 
 
 _P128 = (1 << 128) - (7 << 66) + 1
@@ -140,19 +167,20 @@ _P128_LIMBS = tuple((_P128 >> (32 * i)) & 0xFFFFFFFF for i in range(4))
 def expand_field128(batch_shape: tuple, parts, n: int):
     """Sample n Field128 elements per report: each is two consecutive lanes.
 
-    Returns (elems [*batch_shape, n, 4] uint32, reject [*batch_shape] bool).
+    Returns (elems (4, n) + batch_shape uint32 raw limbs, reject [*batch]).
     """
-    lanes = _squeeze_lanes(build_blocks(batch_shape, parts), 2 * n)
-    limbs = lanes.reshape(batch_shape + (n, 4))
+    lo, hi = _squeeze_lanes(build_blocks(batch_shape, parts), 2 * n)
+    # element j = lanes 2j (low 64 bits) and 2j+1 (high 64 bits)
+    limbs = jnp.stack([lo[0::2], hi[0::2], lo[1::2], hi[1::2]], axis=0)
     # candidate >= p: lexicographic compare from the top limb down.
-    eq = jnp.ones(batch_shape + (n,), dtype=bool)
-    gt = jnp.zeros(batch_shape + (n,), dtype=bool)
+    eq = jnp.ones((n,) + batch_shape, dtype=bool)
+    gt = jnp.zeros((n,) + batch_shape, dtype=bool)
     for i in range(3, -1, -1):
         c = jnp.asarray(np.uint32(_P128_LIMBS[i]))
-        gt = gt | (eq & (limbs[..., i] > c))
-        eq = eq & (limbs[..., i] == c)
+        gt = gt | (eq & (limbs[i] > c))
+        eq = eq & (limbs[i] == c)
     bad = gt | eq
-    return limbs, jnp.any(bad, axis=-1)
+    return limbs, jnp.any(bad, axis=0)
 
 
 def seed_bytes_to_u8(seeds) -> jnp.ndarray:

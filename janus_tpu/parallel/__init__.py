@@ -38,9 +38,14 @@ def report_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (REPORT_AXIS,))
 
 
-def report_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (report) axis across the mesh."""
-    return NamedSharding(mesh, P(REPORT_AXIS))
+def report_sharding(mesh: Mesh, axis: int = 0, rank: int = 1) -> NamedSharding:
+    """Shard a tensor's `axis` (of `rank` total) across the report mesh.
+
+    Host-side wire tensors are batch-LEADING (axis=0); device-resident field
+    tensors are batch-MINOR (axis=rank-1), per the ops layout contract."""
+    spec = [None] * rank
+    spec[axis] = REPORT_AXIS
+    return NamedSharding(mesh, P(*spec))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -54,16 +59,16 @@ def round_up(n: int, multiple: int) -> int:
 def masked_aggregate(fops, raw, mask):
     """Masked modular sum of output shares over the report axis.
 
-    raw:  [N, OUT_LEN, LIMBS] uint32 raw field elements
+    raw:  [LIMBS, OUT_LEN, N] uint32 raw field elements (batch minor)
     mask: [N] bool — True for lanes that contribute (status == finished)
-    ->    [OUT_LEN, LIMBS] raw aggregate share
+    ->    [LIMBS, OUT_LEN] raw aggregate share
 
     Under a report mesh this lowers to per-shard partial sums plus one
     all-reduce — the only collective in the pipeline.
     """
-    x = fops.from_raw(raw)  # [N, OUT_LEN, LIMBS] (limb axis is not logical)
-    x = jnp.where(mask[:, None, None], x, jnp.zeros_like(x))
-    return fops.to_raw(fops.sum_mod(x, axis=0))
+    x = fops.from_raw(raw)
+    x = jnp.where(mask, x, jnp.zeros_like(x))  # mask broadcasts on the minor axis
+    return fops.to_raw(fops.sum_mod(x, axis=-1))
 
 
 def aggregate_fn(fops, mesh: Mesh | None = None):
@@ -72,6 +77,9 @@ def aggregate_fn(fops, mesh: Mesh | None = None):
     fn = lambda raw, mask: masked_aggregate(fops, raw, mask)  # noqa: E731
     if mesh is None:
         return jax.jit(fn)
-    shard = report_sharding(mesh)
-    return jax.jit(fn, in_shardings=(shard, shard),
-                   out_shardings=replicated(mesh))
+    return jax.jit(
+        fn,
+        in_shardings=(report_sharding(mesh, axis=2, rank=3),
+                      report_sharding(mesh, axis=0, rank=1)),
+        out_shardings=replicated(mesh),
+    )

@@ -49,7 +49,7 @@ def test_neg_and_inv():
 def test_from_raw_to_raw():
     vals = _rand_elems(6, seed=4)
     raw = np.array(
-        [[(v >> (32 * i)) & 0xFFFFFFFF for i in range(4)] for v in vals], dtype=np.uint32
+        [[(v >> (32 * i)) & 0xFFFFFFFF for v in vals] for i in range(4)], dtype=np.uint32
     )
     mont = f128.from_raw(raw)
     assert list(f128.unpack(mont)) == vals
@@ -91,7 +91,7 @@ def test_batched_shapes():
          for _ in range(3)], dtype=object
     )
     x = f128.pack(vals)
-    assert x.shape == (3, 4, 4)
+    assert x.shape == (4, 3, 4)  # limb axis leads
     out = f128.unpack(f128.mul(x, x))
     for i in range(3):
         for j in range(4):

@@ -74,7 +74,7 @@ def test_sum_axis():
 def test_poly_eval():
     coeffs = rand_vec(9)
     pts = rand_vec(6, edge_bias=False)
-    C = f64.pack(coeffs)[:, None, :]  # [9, 1, 2] broadcast over points
+    C = f64.pack(coeffs)[:, :, None]  # [2, 9, 1] broadcast over points
     Xs = f64.pack(pts)
     got = f64.unpack(f64.poly_eval(jnp_broadcast(C, 9, 6), Xs))
     assert [int(g) for g in got] == [Field64.poly_eval(coeffs, x) for x in pts]
@@ -83,7 +83,7 @@ def test_poly_eval():
 def jnp_broadcast(c, n, m):
     import jax.numpy as jnp
 
-    return jnp.broadcast_to(c, (n, m, 2))
+    return jnp.broadcast_to(c, (2, n, m))
 
 
 def test_powers():

@@ -25,6 +25,11 @@ def _share(rng, field, vec, num_shares=2):
     return shares
 
 
+def _pack_batch(f, rows):
+    """list of per-report element vectors -> (L, E, N) batch-minor array."""
+    return np.swapaxes(f.pack(rows), 1, 2)
+
+
 CONFIGS = [
     ("count", Count(), [0, 1, 1]),
     ("sum8", Sum(8), [0, 1, 200]),
@@ -60,21 +65,22 @@ def test_query_and_decide_match_oracle(name, valid, measurements):
                 flp.query(ms[agg], ps[agg], query_rand, joint_rand, num_shares)
             )
 
+    K = len(meas_shares)
     verifier, bad_t = bf.query(
-        f.pack(meas_shares),
-        f.pack(proof_shares),
-        f.pack(query_rands),
-        f.pack(joint_rands) if flp.JOINT_RAND_LEN else f.zeros((len(meas_shares), 0)),
+        _pack_batch(f, meas_shares),
+        _pack_batch(f, proof_shares),
+        _pack_batch(f, query_rands),
+        _pack_batch(f, joint_rands) if flp.JOINT_RAND_LEN else f.zeros((0, K)),
         num_shares,
     )
-    got = f.unpack(verifier)
+    got = f.unpack(verifier)  # logical (VERIFIER_LEN, K)
     assert not np.asarray(bad_t).any()
     for i, want in enumerate(want_verifiers):
-        assert list(got[i]) == want, f"verifier mismatch for share {i}"
+        assert list(got[:, i]) == want, f"verifier mismatch for share {i}"
 
     # combined verifier (sum across the two shares of each report) passes decide
-    comb = verifier.reshape((len(measurements), num_shares) + verifier.shape[1:])
-    total = f.add(comb[:, 0], comb[:, 1])
+    comb = verifier.reshape(verifier.shape[:-1] + (len(measurements), num_shares))
+    total = f.add(comb[..., 0], comb[..., 1])  # (L, VLEN, M)
     ok = np.asarray(bf.decide(total))
     assert ok.all()
     for i in range(len(measurements)):
@@ -88,15 +94,14 @@ def test_query_and_decide_match_oracle(name, valid, measurements):
     tampered = list(proof_shares[0])
     tampered[bf.arity] = (tampered[bf.arity] + 1) % field.MODULUS
     bad_ver, _ = bf.query(
-        f.pack([meas_shares[0]]),
-        f.pack([tampered]),
-        f.pack([query_rands[0]]),
-        f.pack([joint_rands[0]]) if flp.JOINT_RAND_LEN else f.zeros((1, 0)),
+        _pack_batch(f, [meas_shares[0]]),
+        _pack_batch(f, [tampered]),
+        _pack_batch(f, [query_rands[0]]),
+        _pack_batch(f, [joint_rands[0]]) if flp.JOINT_RAND_LEN else f.zeros((0, 1)),
         num_shares,
     )
-    bad_total = f.add(bad_ver[0], verifier.reshape(
-        (len(measurements), num_shares) + verifier.shape[1:])[0, 1])
-    assert not bool(np.asarray(bf.decide(bad_total[None])).item())
+    bad_total = f.add(bad_ver[..., 0], comb[..., 0, 1])  # (L, VLEN)
+    assert not bool(np.asarray(bf.decide(bad_total[..., None])).item())
 
 
 @pytest.mark.parametrize("name,valid,measurements", CONFIGS, ids=[c[0] for c in CONFIGS])
@@ -105,9 +110,9 @@ def test_truncate_matches_oracle(name, valid, measurements):
     bf = BatchFlp(flp)
     f = bf.f
     encoded = [valid.encode(m) for m in measurements]
-    got = f.unpack(bf.truncate(f.pack(encoded)))
+    got = f.unpack(bf.truncate(_pack_batch(f, encoded)))  # (OUTPUT_LEN, M)
     for i, e in enumerate(encoded):
-        assert list(got[i]) == valid.truncate(e)
+        assert list(got[:, i]) == valid.truncate(e)
 
 
 def test_bad_t_flag():
@@ -116,10 +121,10 @@ def test_bad_t_flag():
     f = bf.f
     # t = 1 is in the evaluation domain (1^p2 == 1): flag must fire.
     meas = f.pack([[1]])
-    proof = f.pack([[0] * flp.PROOF_LEN])
+    proof = _pack_batch(f, [[0] * flp.PROOF_LEN])
     t_good = f.pack([[12345]])
     t_bad = f.pack([[1]])
-    jr = f.zeros((1, 0))
+    jr = f.zeros((0, 1))
     _, bad = bf.query(meas, proof, t_good, jr, 2)
     assert not bool(np.asarray(bad).item())
     _, bad = bf.query(meas, proof, t_bad, jr, 2)

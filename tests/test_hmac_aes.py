@@ -73,5 +73,6 @@ def test_expand_field64_matches_oracle():
         want = XofHmacSha256Aes128.expand_into_vec(Field64, seed, dst, b"\x01", 20)
         if reject[i]:
             continue  # host fallback lane (probability ~2^-27 here)
-        got = [int(limbs[i, j, 0]) | int(limbs[i, j, 1]) << 32 for j in range(20)]
+        # limbs are (2, n) + batch: limb-leading, batch minor
+        got = [int(limbs[0, j, i]) | int(limbs[1, j, i]) << 32 for j in range(20)]
         assert got == want

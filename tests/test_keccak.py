@@ -12,6 +12,16 @@ from janus_tpu.vdaf import keccak_ref as kr
 rng = random.Random(0x5EED)
 
 
+def _dev_blocks(m: bytes, domain: int):
+    lo, hi = jk.pad_message_to_blocks(m, domain)
+    return jnp.asarray(lo), jnp.asarray(hi)
+
+
+def _lane_ints(lanes):
+    lo, hi = (np.asarray(x) for x in lanes)
+    return [int(lo[i]) | (int(hi[i]) << 32) for i in range(lo.shape[0])]
+
+
 def test_ref_shake128_matches_hashlib():
     for n in (0, 1, 7, 8, 166, 167, 168, 169, 336, 500):
         m = rng.randbytes(n)
@@ -27,50 +37,50 @@ def test_ref_turboshake128_kat():
 def test_jax_permute_matches_ref():
     for rounds in (12, 24):
         lanes = [rng.randrange(1 << 64) for _ in range(25)]
-        st = np.zeros((25, 2), dtype=np.uint32)
-        for i, v in enumerate(lanes):
-            st[i, 0] = v & 0xFFFFFFFF
-            st[i, 1] = v >> 32
-        out = np.asarray(jk.permute(jnp.asarray(st), rounds))
+        lo = np.array([v & 0xFFFFFFFF for v in lanes], dtype=np.uint32)
+        hi = np.array([v >> 32 for v in lanes], dtype=np.uint32)
+        out = jk.permute((jnp.asarray(lo), jnp.asarray(hi)), rounds)
         expect = kr.permute(lanes, rounds)
-        got = [int(out[i, 0]) | (int(out[i, 1]) << 32) for i in range(25)]
-        assert got == expect, f"rounds={rounds}"
+        assert _lane_ints(out) == expect, f"rounds={rounds}"
 
 
 def test_jax_sponge_matches_ref_short_and_long():
     for n in (0, 3, 8, 100, 167, 168, 169, 400, 1000):
         m = rng.randbytes(n)
         domain = 0x01
-        blocks = jnp.asarray(jk.pad_message_to_blocks(m, domain))
-        state = jk.absorb(blocks)
+        state = jk.absorb(_dev_blocks(m, domain))
         out_lanes, _ = jk.squeeze(state, 30)  # > one rate block of output
-        got = jk.lanes_to_bytes(np.asarray(out_lanes))[:240]
+        got = jk.lanes_to_bytes(out_lanes)[:240]
         expect = kr.turboshake128(m, domain, 240)
         assert got == expect, f"len={n}"
 
 
 def test_jax_batched_states():
+    # batch axis is MINOR: stack per-message blocks on the last axis
     msgs = [rng.randbytes(50) for _ in range(6)]
-    blocks = jnp.stack([jnp.asarray(jk.pad_message_to_blocks(m, 0x1F)) for m in msgs])
-    state = jk.absorb(blocks)  # [6, 25, 2] after absorbing [6, 1, 21, 2]
+    pairs = [jk.pad_message_to_blocks(m, 0x1F) for m in msgs]
+    lo = jnp.stack([jnp.asarray(p[0]) for p in pairs], axis=-1)  # [1, 21, 6]
+    hi = jnp.stack([jnp.asarray(p[1]) for p in pairs], axis=-1)
+    state = jk.absorb((lo, hi))  # pair of [25, 6]
     out, _ = jk.squeeze(state, 4)
+    olo, ohi = (np.asarray(x) for x in out)
     for i, m in enumerate(msgs):
-        assert jk.lanes_to_bytes(np.asarray(out[i])) == kr.turboshake128(m, 0x1F, 32)
+        assert jk.lanes_to_bytes((olo[:, i], ohi[:, i])) == kr.turboshake128(m, 0x1F, 32)
 
 
 def test_squeeze_resumable_on_block_boundary():
     m = rng.randbytes(33)
-    state = jk.absorb(jnp.asarray(jk.pad_message_to_blocks(m, 0x1F)))
+    state = jk.absorb(_dev_blocks(m, 0x1F))
     first, st2 = jk.squeeze(state, jk.RATE_LANES)
     second, _ = jk.squeeze(st2, jk.RATE_LANES)
-    both = jk.lanes_to_bytes(np.asarray(first)) + jk.lanes_to_bytes(np.asarray(second))
+    both = jk.lanes_to_bytes(first) + jk.lanes_to_bytes(second)
     assert both == kr.turboshake128(m, 0x1F, 2 * jk.RATE_BYTES)
 
 
 def test_domain_byte_merges_with_pad_on_full_block():
     # len(M || D) exactly one rate block: 0x80 must XOR into the domain byte.
     m = rng.randbytes(167)
-    blocks = jk.pad_message_to_blocks(m, 0x07)
-    assert blocks.shape[0] == 1
-    got = jk.lanes_to_bytes(np.asarray(jk.squeeze(jk.absorb(jnp.asarray(blocks)), 2)[0]))
+    lo, hi = jk.pad_message_to_blocks(m, 0x07)
+    assert lo.shape[0] == 1
+    got = jk.lanes_to_bytes(jk.squeeze(jk.absorb(_dev_blocks(m, 0x07)), 2)[0])
     assert got == kr.turboshake128(m, 0x07, 16)

@@ -72,13 +72,14 @@ def test_sharded_aggregate_matches_oracle():
     while len(rows) % mesh.size:
         rows.append(np.full_like(rows[0], 7))
         mask_rows.append(False)
-    arr = np.stack(rows)
+    # rows are host-layout [OUT, L]; the device batch is [L, OUT, K]
+    arr = np.stack(rows, axis=-1).transpose(1, 0, 2)
     mask = np.asarray(mask_rows)
     fn = aggregate_fn(engine.f, mesh)
-    got = engine._raw_to_ints(np.asarray(fn(arr, mask)))
+    got = engine._raw_to_ints(np.asarray(fn(arr, mask)).T)
     assert got == agg
     # unsharded path agrees too
-    got1 = engine._raw_to_ints(np.asarray(masked_aggregate(engine.f, arr, mask)))
+    got1 = engine._raw_to_ints(np.asarray(masked_aggregate(engine.f, arr, mask)).T)
     assert got1 == agg
 
 

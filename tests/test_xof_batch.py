@@ -41,7 +41,7 @@ def test_expand_field64_matches_oracle():
     for i, seed in enumerate(seeds):
         want = XofTurboShake128.expand_into_vec(Field64, seed, dst, binder, n)
         assert not reject[i]
-        got = [int(elems[i, j, 0]) | int(elems[i, j, 1]) << 32 for j in range(n)]
+        got = [int(elems[0, j, i]) | int(elems[1, j, i]) << 32 for j in range(n)]
         assert got == want
 
 
@@ -57,7 +57,7 @@ def test_expand_field128_matches_oracle():
         want = XofTurboShake128.expand_into_vec(Field128, seed, dst, b"", n)
         assert not reject[i]
         got = [
-            sum(int(elems[i, j, k]) << (32 * k) for k in range(4)) for j in range(n)
+            sum(int(elems[k, j, i]) << (32 * k) for k in range(4)) for j in range(n)
         ]
         assert got == want
 
@@ -88,7 +88,11 @@ def test_reject_flag_fires_on_out_of_range_candidate():
 
 def test_vec_limbs_roundtrip():
     rng = np.random.default_rng(3)
+    # (L=2, n=3, batch=2): per report, wire order is element-major then
+    # little-endian limbs
     x = rng.integers(0, 2**32, size=(2, 3, 2), dtype=np.uint32)
     b = np.asarray(xof_batch.vec_limbs_to_bytes(x))
-    want = x.astype("<u4").tobytes()
-    assert b.tobytes() == want
+    assert b.shape == (2, 3 * 8)
+    for rep in range(2):
+        want = np.ascontiguousarray(x[:, :, rep].T, dtype="<u4").tobytes()
+        assert b[rep].tobytes() == want
