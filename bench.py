@@ -65,10 +65,11 @@ def make_configs(smoke: bool):
         # configs[1]: Prio3Sum bits=32 (job size tuned to 49152)
         ("Prio3Sum32", lambda: prio3.new_sum(32), 1234,
          49_152 // s or 8, 49_152 // s or 8),
-        # configs[2] / north star: Prio3SumVec length=1000 (job size 24576,
-        # the largest bucket the compiler accepts for this circuit)
+        # configs[2] / north star: Prio3SumVec length=1000 (job size 16384:
+        # the unrolled-sponge + FLP program is stable there; 24576 trips a
+        # TPU-worker fault in the XLA runtime on v5e)
         ("Prio3SumVec1000", lambda: prio3.new_sum_vec(1000, 1, cl_sv),
-         [1] * 500 + [0] * 500, 24_576 // s or 8, 24_576 // s or 8),
+         [1] * 500 + [0] * 500, 16_384 // s or 8, 16_384 // s or 8),
         # configs[3]: Prio3Histogram length=256, ~100k reports, multi-job
         ("Prio3Histogram256", lambda: prio3.new_histogram(256, cl_h),
          7, 98_304 // s or 8, 49_152 // s or 8),
@@ -178,7 +179,7 @@ def main():
                                       inits, batch, total)
             # multi-job concurrency (reference P2): overlap host work with
             # device compute; report the better configuration
-            workers = int(os.environ.get("BENCH_WORKERS", "2"))
+            workers = int(os.environ.get("BENCH_WORKERS", "6"))
             rps_mt = 0.0
             if workers > 1:
                 rps_mt, _ = time_batches(engine, verify_key, nonces, pubs,

@@ -5,10 +5,26 @@ XOF the reference consumes from prio — core/src/vdaf.rs:24,184-188): per
 stream, mac = HMAC-SHA256(key=seed, msg=len(dst)||dst||binder) and the
 keystream is AES-128-CTR(key=mac[0:16], iv=mac[16:32]).
 
-Everything is u8/u32 elementwise math plus small static-table gathers
-(AES S-box via jnp.take), vectorized over the report batch; all message
-lengths are static so padding happens at trace time.  Bit-exactness against
-the host oracle is pinned in tests/test_hmac_aes.py.
+TPU design (mirrors the unrolled-lane Keccak in janus_tpu.ops.keccak):
+
+- SHA-256 carries its working variables and message-schedule window as
+  UNROLLED tuples of (N,)-shaped uint32 arrays inside lax.scan — the round
+  wiring is static Python, the ops are pure elementwise over the report
+  batch.  A [N, 8]/[N, 16] array form puts an 8/16-wide axis on the 128-lane
+  dimension and spends the rounds in tiny shuffles.
+- AES-128 is **bitsliced**: state bytes live as 8 bit-planes of shape
+  [16, N, B] uint32, where each u32 word packs 32 counter blocks of one
+  report (B = ceil(nblocks/32)); SubBytes is a boolean circuit — GF(2^8)
+  inversion as x^254 via an addition chain whose squaring/multiplication
+  wiring is DERIVED programmatically from the field polynomial (validated
+  against the classical S-box table in tests), not a transcribed gate list.
+  There are no table gathers anywhere in the keystream path; a jnp.take
+  S-box survives only in the per-report key schedule (44 lookups/report).
+- ShiftRows folds into MixColumns' row reads as static rolls; xtime is a
+  static re-wiring of planes.  Round keys are per-report and broadcast over
+  the packed block axis ([16, N, 1] vs [16, N, B]).
+
+Bit-exactness against the host oracle is pinned in tests/test_hmac_aes.py.
 """
 
 from __future__ import annotations
@@ -21,7 +37,7 @@ _U8 = jnp.uint8
 _U32 = jnp.uint32
 
 # ---------------------------------------------------------------------------
-# SHA-256 (FIPS 180-4)
+# SHA-256 (FIPS 180-4) — unrolled word tuples, batch on the lane axis
 # ---------------------------------------------------------------------------
 
 _K = np.array([
@@ -46,36 +62,31 @@ def _rotr(x, n: int):
     return (x >> _U32(n)) | (x << _U32(32 - n))
 
 
-def _compress(state, block_words):
-    """One SHA-256 compression: state [..., 8], block [..., 16] u32 (BE words).
+def _compress_t(state, block_words):
+    """One SHA-256 compression.
 
-    Rounds run under lax.scan (compile-time discipline: an unrolled 64-round
-    graph per block makes XLA compiles explode on multi-block messages); the
-    carry holds the working variables plus a 16-word schedule shift register.
-    """
+    state: 8-tuple of (N,) u32; block_words: 16-tuple of (N,) u32 (BE words).
+    Rounds run under lax.scan with static wiring (the schedule window is a
+    16-tuple shift register in the carry)."""
     ks = jnp.asarray(_K)
 
     def round_fn(carry, k_t):
-        vars_, window = carry  # [..., 8], [..., 16]
-        w_t = window[..., 0]
-        a, b, c, d, e, f, g, h = [vars_[..., i] for i in range(8)]
+        (a, b, c, d, e, f, g, h), window = carry
+        w_t = window[0]
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
         t1 = h + s1 + ch + k_t + w_t
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
-        new_vars = jnp.stack(
-            [t1 + s0 + maj, a, b, c, d + t1, e, f, g], axis=-1)
-        # extend the schedule: w[t+16] from the current window
-        w1, w14 = window[..., 1], window[..., 14]
+        new_vars = (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+        w1, w14 = window[1], window[14]
         sig0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> _U32(3))
         sig1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> _U32(10))
-        w_next = window[..., 0] + sig0 + window[..., 9] + sig1
-        window = jnp.concatenate([window[..., 1:], w_next[..., None]], axis=-1)
-        return (new_vars, window), None
+        w_next = window[0] + sig0 + window[9] + sig1
+        return (new_vars, window[1:] + (w_next,)), None
 
     (vars_, _), _ = jax.lax.scan(round_fn, (state, block_words), ks)
-    return state + vars_
+    return tuple(s + v for s, v in zip(state, vars_))
 
 
 def _bytes_to_be_words(msg):
@@ -112,15 +123,18 @@ def sha256(msg):
         axis=-1)
     nblocks = padded.shape[-1] // 64
     words = _bytes_to_be_words(padded).reshape(batch_shape + (nblocks, 16))
-    state = jnp.broadcast_to(jnp.asarray(_H0), batch_shape + (8,))
+    state = tuple(jnp.broadcast_to(jnp.asarray(h), batch_shape) for h in _H0)
     if nblocks == 1:
-        state = _compress(state, words[..., 0, :])
+        state = _compress_t(state, tuple(words[..., 0, j] for j in range(16)))
     else:
-        # scan over blocks (blocks axis moved to the front for scan)
-        blocks = jnp.moveaxis(words, -2, 0)
-        state, _ = jax.lax.scan(
-            lambda st, blk: (_compress(st, blk), None), state, blocks)
-    return _be_words_to_bytes(state)
+        # scan over blocks; block axis leads, word index unrolled
+        blocks = jnp.moveaxis(words, -2, 0)  # (nblocks,) + batch + (16,)
+
+        def step(st, blk):
+            return _compress_t(st, tuple(blk[..., j] for j in range(16))), None
+
+        state, _ = jax.lax.scan(step, state, blocks)
+    return _be_words_to_bytes(jnp.stack(state, axis=-1))
 
 
 def hmac_sha256(key, msg):
@@ -135,35 +149,35 @@ def hmac_sha256(key, msg):
 
 
 # ---------------------------------------------------------------------------
-# AES-128 (FIPS 197) — CTR keystream
+# AES-128 (FIPS 197) — CTR keystream, bitsliced
 # ---------------------------------------------------------------------------
+
+
+def _gmul(a, b):
+    r = 0
+    for _ in range(8):
+        if b & 1:
+            r ^= a
+        hi = a & 0x80
+        a = (a << 1) & 0xFF
+        if hi:
+            a ^= 0x1B
+        b >>= 1
+    return r
 
 
 def _make_sbox() -> np.ndarray:
     # Derive the S-box from GF(2^8) inversion + affine map (no table
     # transcription): standard construction.
-    def gmul(a, b):
-        r = 0
-        for _ in range(8):
-            if b & 1:
-                r ^= a
-            hi = a & 0x80
-            a = (a << 1) & 0xFF
-            if hi:
-                a ^= 0x1B
-            b >>= 1
-        return r
-
     def gpow(a, e):
         r, base = 1, a
         while e:
             if e & 1:
-                r = gmul(r, base)
-            base = gmul(base, base)
+                r = _gmul(r, base)
+            base = _gmul(base, base)
             e >>= 1
         return r
 
-    # inverse via Fermat: a^254 in GF(2^8) (a^255 == 1 for a != 0)
     inv = [0] + [gpow(x, 254) for x in range(1, 256)]
     sbox = np.zeros(256, dtype=np.uint8)
     for x in range(256):
@@ -181,23 +195,84 @@ _SBOX = _make_sbox()
 _RCON = np.array([0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36],
                  dtype=np.uint8)
 
+# x^k mod the AES polynomial, k = 0..14: the reduction wiring for bitsliced
+# GF(2^8) multiply/square (derived, not transcribed).
+_RED = [1]
+for _k in range(14):
+    _RED.append(_gmul(_RED[-1], 2))
+_SQ_SRC = [_RED[2 * i] for i in range(8)]  # square of basis element x^i
+
+
+def _bs_square(a):
+    """Bitsliced GF(2^8) square: 8 planes -> 8 planes (pure XOR wiring)."""
+    out = []
+    for b in range(8):
+        acc = None
+        for i in range(8):
+            if (_SQ_SRC[i] >> b) & 1:
+                acc = a[i] if acc is None else (acc ^ a[i])
+        out.append(acc)
+    return out
+
+
+def _bs_mul(a, b):
+    """Bitsliced GF(2^8) multiply: 64 ANDs + reduction XOR tree."""
+    c = [None] * 15
+    for i in range(8):
+        for j in range(8):
+            t = a[i] & b[j]
+            k = i + j
+            c[k] = t if c[k] is None else (c[k] ^ t)
+    out = []
+    for bit in range(8):
+        acc = None
+        for k in range(15):
+            if (_RED[k] >> bit) & 1:
+                acc = c[k] if acc is None else (acc ^ c[k])
+        out.append(acc)
+    return out
+
+
+def _bs_sbox(x):
+    """Bitsliced AES S-box: GF(2^8) inversion (x^254, addition chain:
+    4 multiplies + 7 squarings) followed by the affine map."""
+    t1 = _bs_square(x)                        # x^2
+    t2 = _bs_mul(t1, x)                       # x^3
+    t4 = _bs_square(_bs_square(t2))           # x^12
+    t5 = _bs_mul(t4, t2)                      # x^15
+    t9 = t5
+    for _ in range(4):
+        t9 = _bs_square(t9)                   # x^240
+    t10 = _bs_mul(t9, t4)                     # x^252
+    y = _bs_mul(t10, t1)                      # x^254
+    out = []
+    for b in range(8):
+        v = y[b] ^ y[(b + 4) % 8] ^ y[(b + 5) % 8] ^ y[(b + 6) % 8] ^ y[(b + 7) % 8]
+        if (0x63 >> b) & 1:
+            v = ~v
+        out.append(v)
+    return out
+
+
+def _bs_xtime(a):
+    """Bitsliced xtime (multiply by x): static plane re-wiring, 0x1B taps."""
+    return [a[7], a[0] ^ a[7], a[1], a[2] ^ a[7], a[3] ^ a[7],
+            a[4], a[5], a[6]]
+
 
 def _sub_bytes(x):
+    """Table S-box via gather — used only on the tiny key-schedule path."""
     return jnp.take(jnp.asarray(_SBOX), x.astype(jnp.int32), axis=0).astype(_U8)
-
-
-def _xtime(x):
-    return ((x << _U8(1)) ^ ((x >> _U8(7)) * _U8(0x1B))).astype(_U8)
 
 
 def aes128_key_schedule(key):
     """key u8 [..., 16] -> 11 round keys u8 [..., 11, 16].
 
-    One scan step per round key (the carry is the previous round key)."""
+    One scan step per round key (the carry is the previous round key).
+    Gather-based S-box: 44 lookups per report, off the hot path."""
     rcons = jnp.asarray(_RCON)
 
     def step(rk, rcon):
-        # rk [..., 16]; words w0..w3 -> next four words
         prev = rk[..., 12:16]
         rot = jnp.concatenate([prev[..., 1:], prev[..., :1]], axis=-1)
         sub = _sub_bytes(rot)
@@ -214,49 +289,101 @@ def aes128_key_schedule(key):
     return jnp.concatenate([key.astype(_U8)[..., None, :], rks], axis=-2)
 
 
-# ShiftRows on the flat byte layout (byte i of the block maps to AES state
-# cell [row=i%4, col=i//4]; row r rotates left by r).
-_SHIFT_IDX = np.array([(i + 4 * (i % 4)) % 16 for i in range(16)], dtype=np.int32)
+# byte i of a block maps to AES state cell [row = i % 4, col = i // 4];
+# ShiftRows rotates row r left by r (i.e. cell [r, c] reads [r, (c + r) % 4]).
 
 
-def _aes_rounds(block, round_keys):
-    """block u8 [..., 16], round_keys [..., 11, 16] -> encrypted block.
+def _bs_mix_shift(planes):
+    """Fused ShiftRows + MixColumns on bit planes [16, N, B].
 
-    Nine scanned middle rounds + the final (no-MixColumns) round."""
-    shift = jnp.asarray(_SHIFT_IDX)
-    s = block ^ round_keys[..., 0, :]
-    mid_keys = jnp.moveaxis(round_keys[..., 1:10, :], -2, 0)  # [9, ..., 16]
-
-    def round_fn(state, rk):
-        state = _sub_bytes(state)
-        state = jnp.take(state, shift, axis=-1)
-        cols = state.reshape(state.shape[:-1] + (4, 4))  # [..., col, row]
-        a0, a1, a2, a3 = (cols[..., 0], cols[..., 1], cols[..., 2],
-                          cols[..., 3])
-        x0, x1, x2, x3 = _xtime(a0), _xtime(a1), _xtime(a2), _xtime(a3)
-        m0 = x0 ^ (x1 ^ a1) ^ a2 ^ a3
-        m1 = a0 ^ x1 ^ (x2 ^ a2) ^ a3
-        m2 = a0 ^ a1 ^ x2 ^ (x3 ^ a3)
-        m3 = (x0 ^ a0) ^ a1 ^ a2 ^ x3
-        state = jnp.stack([m0, m1, m2, m3], axis=-1).reshape(state.shape)
-        return state ^ rk, None
-
-    s, _ = jax.lax.scan(round_fn, s, mid_keys)
-    s = _sub_bytes(s)
-    s = jnp.take(s, shift, axis=-1)
-    return s ^ round_keys[..., 10, :]
+    Row reads use static rolls on the column axis (ShiftRows folded in);
+    MixColumns is the usual 2a0+3a1+a2+a3 wiring with bitsliced xtime."""
+    a = [[None] * 8 for _ in range(4)]  # [row][plane] -> [4cols, N, B]
+    for b in range(8):
+        cells = planes[b].reshape((4, 4) + planes[b].shape[1:])  # [col, row, ...]
+        for r in range(4):
+            a[r][b] = jnp.roll(cells[:, r], -r, axis=0)
+    xt = [_bs_xtime(a[r]) for r in range(4)]
+    out_rows = []
+    for b in range(8):
+        m0 = xt[0][b] ^ (xt[1][b] ^ a[1][b]) ^ a[2][b] ^ a[3][b]
+        m1 = a[0][b] ^ xt[1][b] ^ (xt[2][b] ^ a[2][b]) ^ a[3][b]
+        m2 = a[0][b] ^ a[1][b] ^ xt[2][b] ^ (xt[3][b] ^ a[3][b])
+        m3 = (xt[0][b] ^ a[0][b]) ^ a[1][b] ^ a[2][b] ^ xt[3][b]
+        out_rows.append((m0, m1, m2, m3))
+    out = []
+    for b in range(8):
+        stacked = jnp.stack(out_rows[b], axis=1)  # [col, row, N, B]
+        out.append(stacked.reshape(planes[b].shape))
+    return out
 
 
-def aes128_ctr(key, iv, n_bytes: int):
-    """Batched AES-128-CTR keystream: key/iv u8 [..., 16] -> u8 [..., n_bytes].
+def _bs_shift_rows(planes):
+    out = []
+    for b in range(8):
+        cells = planes[b].reshape((4, 4) + planes[b].shape[1:])
+        rows = [jnp.roll(cells[:, r], -r, axis=0) for r in range(4)]
+        out.append(jnp.stack(rows, axis=1).reshape(planes[b].shape))
+    return out
 
-    The 16-byte IV is the initial big-endian counter block (OpenSSL/CTR mode
-    semantics, matching cryptography's modes.CTR)."""
-    batch_shape = key.shape[:-1]
-    n_blocks = (n_bytes + 15) // 16
-    rks = aes128_key_schedule(key)
-    # counter = iv + block_index with big-endian carry, via 4 BE u32 limbs
-    iv_words = _bytes_to_be_words(iv)  # [..., 4], word 3 least significant
+
+def _pack_block_bits(x, n_blocks_pad: int):
+    """Counter bytes [N, NB, 16] u8 -> 8 bit planes [16, N, B] u32 packing 32
+    blocks per word (NB padded to n_blocks_pad = 32*B)."""
+    N, NB, _ = x.shape
+    B = n_blocks_pad // 32
+    if NB < n_blocks_pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((N, n_blocks_pad - NB, 16), dtype=_U8)], axis=1)
+    weights = (_U32(1) << jnp.arange(32, dtype=_U32))  # block k -> bit k
+    planes = []
+    for b in range(8):
+        bits = ((x >> _U8(b)) & _U8(1)).astype(_U32)  # [N, 32B, 16]
+        w = (bits.reshape(N, B, 32, 16) * weights[None, None, :, None]).sum(
+            axis=2, dtype=_U32)  # [N, B, 16]
+        planes.append(jnp.transpose(w, (2, 0, 1)))  # [16, N, B]
+    return planes
+
+
+def _key_planes(rk):
+    """Round key u8 [N, 16] -> 8 planes [16, N, 1] u32 of 0/~0 words.
+
+    A key bit set means XOR-ALL-32-lanes of the packed word, so the plane
+    word is all-ones where the bit is set."""
+    planes = []
+    for b in range(8):
+        bits = ((rk >> _U8(b)) & _U8(1)).astype(_U32)  # [N, 16]
+        full = (_U32(0) - bits)  # 0 or 0xFFFFFFFF
+        planes.append(jnp.transpose(full, (1, 0))[:, :, None])  # [16, N, 1]
+    return planes
+
+
+def _planes_to_words(planes):
+    """Bit planes [16, N, B] -> little-endian u32 keystream words [4, N, 32B].
+
+    Word w of a block is bytes 4w..4w+3 LE; block k of packed word j is bit
+    k.  Unpacks via a static loop over the 32 packed lanes."""
+    N, B = planes[0].shape[1], planes[0].shape[2]
+    out = []
+    for w in range(4):
+        per_k = []
+        for k in range(32):
+            word = None
+            for i in range(4):
+                byte = None
+                for b in range(8):
+                    t = ((planes[b][4 * w + i] >> _U32(k)) & _U32(1)) << _U32(b)
+                    byte = t if byte is None else (byte | t)
+                byte = byte << _U32(8 * i)
+                word = byte if word is None else (word | byte)
+            per_k.append(word)  # [N, B]
+        out.append(jnp.stack(per_k, axis=-1).reshape(N, 32 * B))  # [N, 32B]
+    return jnp.stack(out, axis=0)  # [4, N, 32B]
+
+
+def _ctr_counters(iv, n_blocks: int):
+    """IV u8 [N, 16] -> counter blocks u8 [N, n_blocks, 16] (BE increment)."""
+    iv_words = _bytes_to_be_words(iv)  # [N, 4], word 3 least significant
     idx = jnp.arange(n_blocks, dtype=_U32)
     w3 = iv_words[..., 3, None] + idx
     carry3 = (w3 < iv_words[..., 3, None]).astype(_U32)
@@ -265,12 +392,56 @@ def aes128_ctr(key, iv, n_bytes: int):
     w1 = iv_words[..., 1, None] + carry2
     carry1 = (w1 < iv_words[..., 1, None]).astype(_U32)
     w0 = iv_words[..., 0, None] + carry1
-    counters = jnp.stack([w0, w1, w2, w3], axis=-1)  # [..., n_blocks, 4]
-    counter_bytes = _be_words_to_bytes(counters)  # [..., n_blocks, 16]
-    rks_b = jnp.broadcast_to(rks[..., None, :, :],
-                             batch_shape + (n_blocks, 11, 16))
-    stream = _aes_rounds(counter_bytes, rks_b)
-    return stream.reshape(batch_shape + (n_blocks * 16,))[..., :n_bytes]
+    counters = jnp.stack([w0, w1, w2, w3], axis=-1)  # [N, n_blocks, 4]
+    return _be_words_to_bytes(counters)  # [N, n_blocks, 16]
+
+
+def aes128_ctr_words(key, iv, n_words: int):
+    """Batched bitsliced AES-128-CTR keystream as little-endian u32 words.
+
+    key/iv u8 [N, 16] -> u32 [n_words, N] (the keystream's 4-byte LE groups,
+    which are exactly the Field64 limb stream the XOF consumes)."""
+    N = key.shape[0]
+    n_blocks = (n_words + 3) // 4
+    B = -(-n_blocks // 32)
+    rks = aes128_key_schedule(key)  # [N, 11, 16]
+    state = _pack_block_bits(_ctr_counters(iv, n_blocks), 32 * B)
+    k0 = _key_planes(rks[:, 0])
+    state = [s ^ k for s, k in zip(state, k0)]
+
+    mid_planes = [_key_planes(rks[:, r]) for r in range(1, 10)]
+    # stack per plane for scan: [9, 16, N, 1]
+    xs = [jnp.stack([mid_planes[r][b] for r in range(9)], axis=0)
+          for b in range(8)]
+
+    def round_fn(planes, rk_planes):
+        planes = _bs_sbox(list(planes))
+        planes = _bs_mix_shift(planes)
+        return tuple(p ^ k for p, k in zip(planes, rk_planes)), None
+
+    state, _ = jax.lax.scan(round_fn, tuple(state), tuple(xs))
+    state = _bs_sbox(list(state))
+    state = _bs_shift_rows(state)
+    k10 = _key_planes(rks[:, 10])
+    state = [s ^ k for s, k in zip(state, k10)]
+    words = _planes_to_words(state)  # [4, N, 32B]
+    # word j of block k sits at stream position 4k + j
+    stream = jnp.transpose(words, (2, 0, 1)).reshape(4 * 32 * B, N)
+    return stream[:n_words]
+
+
+def aes128_ctr(key, iv, n_bytes: int):
+    """Batched AES-128-CTR keystream: key/iv u8 [..., 16] -> u8 [..., n_bytes].
+
+    The 16-byte IV is the initial big-endian counter block (OpenSSL/CTR mode
+    semantics, matching cryptography's modes.CTR)."""
+    batch_shape = key.shape[:-1]
+    N = int(np.prod(batch_shape)) if batch_shape else 1
+    n_words = (n_bytes + 3) // 4
+    words = aes128_ctr_words(key.reshape(N, 16), iv.reshape(N, 16), n_words)
+    stream = jax.lax.bitcast_convert_type(
+        jnp.transpose(words, (1, 0)), _U8).reshape(N, 4 * n_words)
+    return stream[:, :n_bytes].reshape(batch_shape + (n_bytes,))
 
 
 # ---------------------------------------------------------------------------
@@ -295,9 +466,7 @@ def _assemble(batch_shape: tuple, parts):
     return jnp.concatenate(segs, axis=-1)
 
 
-def xof_stream(batch_shape: tuple, seed, msg_parts, n_bytes: int):
-    """Batched XofHmacSha256Aes128: seed u8 [..., 32] (or static bytes),
-    message segments as in xof_batch.build_blocks -> keystream u8 [..., n]."""
+def _mac(batch_shape: tuple, seed, msg_parts):
     if isinstance(seed, (bytes, bytearray)):
         seed = jnp.broadcast_to(
             jnp.asarray(np.frombuffer(bytes(seed), dtype=np.uint8)),
@@ -305,7 +474,13 @@ def xof_stream(batch_shape: tuple, seed, msg_parts, n_bytes: int):
     else:
         seed = jnp.asarray(seed, dtype=_U8).reshape(batch_shape + (-1,))
     msg = _assemble(batch_shape, msg_parts)
-    mac = hmac_sha256(seed, msg)
+    return hmac_sha256(seed, msg)
+
+
+def xof_stream(batch_shape: tuple, seed, msg_parts, n_bytes: int):
+    """Batched XofHmacSha256Aes128: seed u8 [..., 32] (or static bytes),
+    message segments as in xof_batch.build_blocks -> keystream u8 [..., n]."""
+    mac = _mac(batch_shape, seed, msg_parts)
     return aes128_ctr(mac[..., :16], mac[..., 16:32], n_bytes)
 
 
@@ -317,17 +492,17 @@ _P64 = (1 << 64) - (1 << 32) + 1
 
 
 def expand_field64(batch_shape: tuple, seed, msg_parts, n: int):
-    """Sample n Field64 elements per report (speculative rejection sampling,
-    same contract as xof_batch.expand_field64: raw limbs (2, n) + batch)."""
-    bn = len(batch_shape)
-    stream = xof_stream(batch_shape, seed, msg_parts, 8 * n)
-    le = stream.reshape(batch_shape + (n, 2, 4)).astype(_U32)
-    limbs = (le[..., 0] | (le[..., 1] << _U32(8))
-             | (le[..., 2] << _U32(16)) | (le[..., 3] << _U32(24)))
-    lo, hi = limbs[..., 0], limbs[..., 1]  # each batch + (n,)
+    """Sample n Field64 elements per report (speculative rejection sampling;
+    output layout matches xof_batch.expand_field64: raw limbs (2, n) + batch,
+    but only a rank-1 batch_shape=(N,) is supported — the bitsliced CTR packs
+    blocks along the one report axis).
+
+    The bitsliced CTR emits the keystream directly as LE u32 words, which ARE
+    the Field64 limb pairs — no byte re-assembly."""
+    assert len(batch_shape) == 1, "the multiproof engine batches on one axis"
+    N = batch_shape[0]
+    mac = _mac(batch_shape, seed, msg_parts)
+    words = aes128_ctr_words(mac[..., :16], mac[..., 16:32], 2 * n)  # [2n, N]
+    lo, hi = words[0::2], words[1::2]  # each [n, N]
     bad = (hi == _U32(0xFFFFFFFF)) & (lo >= _U32(1))
-    reject = jnp.any(bad, axis=-1)
-    # -> the engine's limb-leading / batch-minor layout
-    perm = (bn,) + tuple(range(bn))
-    out = jnp.stack([jnp.transpose(lo, perm), jnp.transpose(hi, perm)], axis=0)
-    return out, reject
+    return jnp.stack([lo, hi], axis=0), jnp.any(bad, axis=0)

@@ -8,15 +8,18 @@ runs the permutation across an arbitrary batch of states at once.
 
 Design notes (TPU/XLA-first):
 - A state is a PAIR of uint32 arrays (lo, hi), each of shape (25,) + batch
-  ([i] = low/high 32 bits of Keccak lane i).  The Keccak lane axis LEADS and
-  the report batch is the MINOR axis: TPU vector registers are (8 sublanes,
-  128 lanes) tiles over the two minor dims, so the batch axis fills every
-  lane; a trailing (25, 2) layout would leave the 128-lane dimension 2/128
-  occupied.  The round body is ~20 *vector* ops over the lane axis (theta as
-  an XOR-reduction + roll, rho as per-lane tensor shifts, pi as one static
-  gather, chi as rolls) — not 3600 scalar ops.
-- Rounds run under lax.scan with the round constants as the scanned operand:
-  one compiled body regardless of 12 vs 24 rounds.
+  ([i] = low/high 32 bits of Keccak lane i) at the API boundary; the batch is
+  the MINOR axis so vector registers tile (lanes, reports).
+- INSIDE the permutation the 25 lanes are unrolled into 25 separate arrays of
+  shape `batch`: theta/rho/pi/chi become pure elementwise XOR/AND/shift ops
+  with the lane wiring resolved at trace time (static Python indexing and
+  constant rotate amounts).  A [25, N]-array formulation spends most of its
+  time in rolls/gathers over the lane axis — pure data movement that an
+  ablation showed dominating the sponge cost; the unrolled form has zero
+  data-movement ops in the round body.
+- Rounds run under lax.scan with the round constants as the scanned operand
+  and the 50 lane arrays as the carry: one compiled body regardless of 12 vs
+  24 rounds.
 - Keccak lanes are little-endian u64, so a canonical Field64 limb pair
   (lo, hi) *is* a lane — field data enters the sponge with no byte shuffling.
 
@@ -38,81 +41,87 @@ RATE_LANES = 21
 
 _U32 = jnp.uint32
 
-# pi step as a single gather: OUT[dst] = IN[_PI_SRC[dst]]
-_PI_SRC = np.zeros(25, dtype=np.int32)
+# pi step: OUT[y + 5*((2x + 3y) % 5)] = IN[x + 5y]
+_PI_DST = np.zeros(25, dtype=np.int32)
 for _x in range(5):
     for _y in range(5):
-        _PI_SRC[_y + 5 * ((2 * _x + 3 * _y) % 5)] = _x + 5 * _y
+        _PI_DST[_x + 5 * _y] = _y + 5 * ((2 * _x + 3 * _y) % 5)
 
 _RC_LIMBS = np.array(
     [[rc & 0xFFFFFFFF, rc >> 32] for rc in ROUND_CONSTANTS], dtype=np.uint32
 )
 
-# per-lane rho rotations, applied at rho time with offsets in source-lane order.
-_RHO = np.array(ROTATION_OFFSETS, dtype=np.uint32)
+_RHO = [int(r) for r in ROTATION_OFFSETS]
 
 
-def _rotl_by(lo, hi, n):
-    """Rotate-left (lo, hi) u64 lanes by per-lane amounts n (uint32, 0..63).
-
-    n broadcasts against the LEADING lane axis (shape (25,) + (1,)*batch)."""
-    swap = (n & 32).astype(bool)
-    r = n & 31
-    a = jnp.where(swap, hi, lo)
-    b = jnp.where(swap, lo, hi)
-    # (a, b) rotated left by r within each 32-bit half-pair:
-    # new_lo = a << r | b >> (32 - r), new_hi = b << r | a >> (32 - r)
-    # guard r == 0 (shift by 32 is undefined): contribution is 0 there.
-    rs = jnp.where(r == 0, _U32(0), _U32(32) - r)
-    carry_b = jnp.where(r == 0, _U32(0), b >> rs)
-    carry_a = jnp.where(r == 0, _U32(0), a >> rs)
-    return (a << r) | carry_b, (b << r) | carry_a
+def _rotl_const(lo, hi, r: int):
+    """Rotate-left a u64 lane pair by a COMPILE-TIME amount r (0..63)."""
+    r &= 63
+    if r == 0:
+        return lo, hi
+    if r == 32:
+        return hi, lo
+    if r > 32:
+        lo, hi = hi, lo
+        r -= 32
+    rr = _U32(r)
+    rs = _U32(32 - r)
+    return (lo << rr) | (hi >> rs), (hi << rr) | (lo >> rs)
 
 
-def _round(lo, hi, rc):
-    """One Keccak round on ((25,)+batch, (25,)+batch); rc is a (2,) pair."""
-    batch = lo.shape[1:]
-    ones_ = (1,) * len(batch)
-    lo5 = lo.reshape((5, 5) + batch)  # [y, x, ...]
-    hi5 = hi.reshape((5, 5) + batch)
+def _round_lanes(los, his, rc):
+    """One Keccak round on 25 unrolled lane pairs; rc is a (2,) uint32 pair."""
     # theta
-    clo = jax.lax.reduce(lo5, _U32(0), jax.lax.bitwise_xor, [0])  # [x, ...]
-    chi = jax.lax.reduce(hi5, _U32(0), jax.lax.bitwise_xor, [0])
-    rlo, rhi = _rotl_by(jnp.roll(clo, -1, axis=0), jnp.roll(chi, -1, axis=0), _U32(1))
-    dlo = jnp.roll(clo, 1, axis=0) ^ rlo
-    dhi = jnp.roll(chi, 1, axis=0) ^ rhi
-    lo5 = lo5 ^ dlo[None]
-    hi5 = hi5 ^ dhi[None]
-    lo = lo5.reshape((25,) + batch)
-    hi = hi5.reshape((25,) + batch)
-    # rho (per-lane static rotation) then pi (static gather on the lane axis)
-    lo, hi = _rotl_by(lo, hi, jnp.asarray(_RHO).reshape((25,) + ones_))
-    lo = lo[_PI_SRC]
-    hi = hi[_PI_SRC]
-    # chi: a[x] = b[x] ^ (~b[x+1] & b[x+2]) along the x axis
-    lo5 = lo.reshape((5, 5) + batch)
-    hi5 = hi.reshape((5, 5) + batch)
-    lo5 = lo5 ^ (~jnp.roll(lo5, -1, axis=1) & jnp.roll(lo5, -2, axis=1))
-    hi5 = hi5 ^ (~jnp.roll(hi5, -1, axis=1) & jnp.roll(hi5, -2, axis=1))
-    lo = lo5.reshape((25,) + batch)
-    hi = hi5.reshape((25,) + batch)
+    clo = [los[x] ^ los[x + 5] ^ los[x + 10] ^ los[x + 15] ^ los[x + 20]
+           for x in range(5)]
+    chi_ = [his[x] ^ his[x + 5] ^ his[x + 10] ^ his[x + 15] ^ his[x + 20]
+            for x in range(5)]
+    dlo, dhi = [None] * 5, [None] * 5
+    for x in range(5):
+        rl, rh = _rotl_const(clo[(x + 1) % 5], chi_[(x + 1) % 5], 1)
+        dlo[x] = clo[(x - 1) % 5] ^ rl
+        dhi[x] = chi_[(x - 1) % 5] ^ rh
+    los = [los[i] ^ dlo[i % 5] for i in range(25)]
+    his = [his[i] ^ dhi[i % 5] for i in range(25)]
+    # rho + pi (static rotation amounts, static lane permutation)
+    blo, bhi = [None] * 25, [None] * 25
+    for i in range(25):
+        blo[_PI_DST[i]], bhi[_PI_DST[i]] = _rotl_const(los[i], his[i], _RHO[i])
+    # chi
+    los, his = [None] * 25, [None] * 25
+    for y in range(5):
+        for x in range(5):
+            i = x + 5 * y
+            i1 = (x + 1) % 5 + 5 * y
+            i2 = (x + 2) % 5 + 5 * y
+            los[i] = blo[i] ^ (~blo[i1] & blo[i2])
+            his[i] = bhi[i] ^ (~bhi[i1] & bhi[i2])
     # iota
-    lo = lo.at[0].set(lo[0] ^ rc[0])
-    hi = hi.at[0].set(hi[0] ^ rc[1])
-    return lo, hi
+    los[0] = los[0] ^ rc[0]
+    his[0] = his[0] ^ rc[1]
+    return los, his
+
+
+def _permute_lanes(los, his, rounds: int = 12):
+    """Keccak-p on unrolled lane lists (each entry shape = batch)."""
+    assert 1 <= rounds <= 24, "Keccak-p[1600] round count must be in [1, 24]"
+    rcs = jnp.asarray(_RC_LIMBS[24 - rounds:])
+
+    def step(st, rc):
+        lo, hi = _round_lanes(list(st[0]), list(st[1]), rc)
+        return (tuple(lo), tuple(hi)), None
+
+    (los, his), _ = jax.lax.scan(step, (tuple(los), tuple(his)), rcs)
+    return list(los), list(his)
 
 
 def permute(state, rounds: int = 12):
     """Keccak-p[1600, rounds] on a batch of states ((25,)+b, (25,)+b) pairs
     (the last `rounds` rounds of Keccak-f[1600])."""
-    assert 1 <= rounds <= 24, "Keccak-p[1600] round count must be in [1, 24]"
-    rcs = jnp.asarray(_RC_LIMBS[24 - rounds:])
-
-    def step(st, rc):
-        return _round(st[0], st[1], rc), None
-
-    state, _ = jax.lax.scan(step, state, rcs)
-    return state
+    lo, hi = state
+    los, his = _permute_lanes([lo[i] for i in range(25)],
+                              [hi[i] for i in range(25)], rounds)
+    return jnp.stack(los, axis=0), jnp.stack(his, axis=0)
 
 
 def zero_state(batch_shape: tuple):
@@ -120,12 +129,9 @@ def zero_state(batch_shape: tuple):
     return z, z
 
 
-def _xor_block(state, block):
-    """XOR a 21-lane block pair into the first 21 lanes of the state pair."""
-    lo, hi = state
-    blo, bhi = block
-    return lo.at[:RATE_LANES].set(lo[:RATE_LANES] ^ blo), \
-        hi.at[:RATE_LANES].set(hi[:RATE_LANES] ^ bhi)
+def _zero_lanes(batch_shape: tuple):
+    z = jnp.zeros(tuple(batch_shape), dtype=_U32)
+    return [z] * 25, [z] * 25
 
 
 def absorb(blocks, rounds: int = 12):
@@ -136,18 +142,58 @@ def absorb(blocks, rounds: int = 12):
     axis so long messages (e.g. joint-rand binders over encoded measurement
     shares) compile to a single rolled loop.
     """
+    los, his = _absorb_lanes(blocks, rounds)
+    return jnp.stack(los, axis=0), jnp.stack(his, axis=0)
+
+
+def _absorb_lanes(blocks, rounds: int = 12):
     blo, bhi = blocks
     nblocks = blo.shape[0]
-    state = zero_state(blo.shape[2:])
+    los, his = _zero_lanes(blo.shape[2:])
     if nblocks == 1:
-        # common case (short messages): avoid scan overhead
-        return permute(_xor_block(state, (blo[0], bhi[0])), rounds)
+        for j in range(RATE_LANES):
+            los[j] = los[j] ^ blo[0, j]
+            his[j] = his[j] ^ bhi[0, j]
+        return _permute_lanes(los, his, rounds)
 
     def step(st, blk):
-        return permute(_xor_block(st, blk), rounds), None
+        lo = list(st[0])
+        hi = list(st[1])
+        bl, bh = blk
+        for j in range(RATE_LANES):
+            lo[j] = lo[j] ^ bl[j]
+            hi[j] = hi[j] ^ bh[j]
+        lo, hi = _permute_lanes(lo, hi, rounds)
+        return (tuple(lo), tuple(hi)), None
 
-    state, _ = jax.lax.scan(step, state, (blo, bhi))
-    return state
+    (los, his), _ = jax.lax.scan(step, (tuple(los), tuple(his)), (blo, bhi))
+    return list(los), list(his)
+
+
+def _squeeze_lanes_scan(los, his, n_lanes: int, rounds: int):
+    """ONE scan over output blocks: each iteration yields the current rate
+    lanes and advances the state by a permutation.  Returns (out_lo, out_hi
+    each [n_lanes, *batch], final lane lists)."""
+    nblocks_out = -(-n_lanes // RATE_LANES)
+    if nblocks_out == 1:
+        out_lo = jnp.stack(los[:n_lanes], axis=0)
+        out_hi = jnp.stack(his[:n_lanes], axis=0)
+        los, his = _permute_lanes(los, his, rounds)
+        return out_lo, out_hi, los, his
+
+    def step(st, _):
+        lo, hi = st
+        ys = (lo[:RATE_LANES], hi[:RATE_LANES])
+        nlo, nhi = _permute_lanes(list(lo), list(hi), rounds)
+        return (tuple(nlo), tuple(nhi)), ys
+
+    (flo, fhi), (ys_lo, ys_hi) = jax.lax.scan(
+        step, (tuple(los), tuple(his)), None, length=nblocks_out)
+    # ys_*: tuples of 21 arrays, each [nblocks_out, *batch]
+    batch = ys_lo[0].shape[1:]
+    out_lo = jnp.stack(ys_lo, axis=1).reshape((nblocks_out * RATE_LANES,) + batch)
+    out_hi = jnp.stack(ys_hi, axis=1).reshape((nblocks_out * RATE_LANES,) + batch)
+    return out_lo[:n_lanes], out_hi[:n_lanes], list(flo), list(fhi)
 
 
 def squeeze(state, n_lanes: int, rounds: int = 12):
@@ -161,19 +207,18 @@ def squeeze(state, n_lanes: int, rounds: int = 12):
     callers needing exact byte-stream resumption must track their own offset
     (the vdaf XOF layer squeezes whole streams in one call).
     """
-    los, his = [], []
-    remaining = n_lanes
-    while True:
-        take = min(remaining, RATE_LANES)
-        los.append(state[0][:take])
-        his.append(state[1][:take])
-        remaining -= take
-        state = permute(state, rounds)
-        if remaining == 0:
-            break
-    if len(los) > 1:
-        return (jnp.concatenate(los, axis=0), jnp.concatenate(his, axis=0)), state
-    return (los[0], his[0]), state
+    lo, hi = state
+    out_lo, out_hi, flo, fhi = _squeeze_lanes_scan(
+        [lo[i] for i in range(25)], [hi[i] for i in range(25)], n_lanes, rounds)
+    return (out_lo, out_hi), (jnp.stack(flo, axis=0), jnp.stack(fhi, axis=0))
+
+
+def absorb_squeeze(blocks, n_lanes: int, rounds: int = 12):
+    """Fused absorb + squeeze entirely in unrolled-lane form (no intermediate
+    [25, N] restacking): -> (lo, hi) each [n_lanes, *batch]."""
+    los, his = _absorb_lanes(blocks, rounds)
+    out_lo, out_hi, _, _ = _squeeze_lanes_scan(los, his, n_lanes, rounds)
+    return out_lo, out_hi
 
 
 def pad_message_to_blocks(message: bytes, domain: int):

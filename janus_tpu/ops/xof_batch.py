@@ -135,9 +135,7 @@ def vec_limbs_to_bytes(x):
 
 def _squeeze_lanes(blocks, n_lanes: int):
     """Absorb blocks and squeeze n_lanes: -> pair ((n_lanes,)+batch lo, hi)."""
-    state = keccak.absorb(blocks)
-    lanes, _ = keccak.squeeze(state, n_lanes)
-    return lanes
+    return keccak.absorb_squeeze(blocks, n_lanes)
 
 
 def derive_seed(batch_shape: tuple, parts, seed_size: int = 16):
