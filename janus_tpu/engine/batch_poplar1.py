@@ -1,0 +1,271 @@
+"""Batched Poplar1 preparation: IDPF walk + sketch on device.
+
+The Poplar1 prepare hot loop (reference: prio's poplar1 consumed via
+core/src/vdaf.rs:95; sequential per report per candidate prefix) has two
+expensive parts per report: evaluating the IDPF key over every candidate
+prefix, and the sketch dot products over the prefix axis.  Both run here as
+one jitted program over the whole (reports x prefixes) grid
+(janus_tpu.ops.idpf_batch + the Field64 kernels); the remaining protocol
+work — ping-pong framing, the round-2 affine sigma — is O(1) per report and
+stays on the host, driven through the UNMODIFIED oracle code via a shim vdaf
+whose `prep_init` returns the device-computed (state, round-1 share).  That
+keeps the wire behavior bit-identical to the oracle by construction.
+
+Device path: inner levels (Field64).  The leaf level (Field255 payloads)
+falls back to the host oracle per report, as does any report whose XOF
+sampling hit a rejection (~2^-32 per sampled element).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from janus_tpu.engine.host import HostPrepEngine
+from janus_tpu.vdaf import idpf as _idpf
+from janus_tpu.vdaf import ping_pong
+from janus_tpu.vdaf.poplar1 import Poplar1
+from janus_tpu.vdaf.prio3 import PrepShare, PrepState, VdafError
+
+
+class _CachedPrepVdaf:
+    """Delegating vdaf whose prep_init returns a precomputed result —
+    lets the oracle ping-pong code drive device-computed preparations."""
+
+    __slots__ = ("_vdaf", "_cached")
+
+    def __init__(self, vdaf, cached):
+        self._vdaf = vdaf
+        self._cached = cached
+
+    def prep_init(self, verify_key, agg_id, nonce, public_share, input_share):
+        return self._cached
+
+    def __getattr__(self, name):
+        return getattr(self._vdaf, name)
+
+
+class BatchPoplar1(HostPrepEngine):
+    """HostPrepEngine with the per-report IDPF+sketch replaced by one
+    device batch per call (inner levels)."""
+
+    def __init__(self, vdaf: Poplar1, device_min_batch: int = 32,
+                 _fns: dict | None = None):
+        super().__init__(vdaf)
+        # jitted-kernel cache, SHARED with every bound copy (the aggregator
+        # binds a fresh engine per job; a per-instance cache would recompile
+        # per request).  Keyed on everything the kernel closure bakes in:
+        # (bucketed N, P, level, party, verify_key).
+        self._fns = {} if _fns is None else _fns
+        # below this many reports the jit dispatch (and on cold caches the
+        # compile) costs more than the host loop; small service batches take
+        # the oracle path
+        self.device_min_batch = device_min_batch
+
+    def bind(self, agg_param: bytes) -> "BatchPoplar1":
+        return BatchPoplar1(self.vdaf.with_agg_param(agg_param),
+                            self.device_min_batch, _fns=self._fns)
+
+    # -- device batch ------------------------------------------------------
+
+    def _device_eligible(self) -> bool:
+        if self.vdaf._agg_param is None:
+            return False
+        level, prefixes = self.vdaf._agg_param
+        # leaf level carries Field255 payloads: host path
+        return level < self.vdaf.bits - 1 and len(prefixes) > 0
+
+    def _precompute(self, verify_key: bytes, agg_id: int, nonces, decoded):
+        """Device batch over all decodable reports.
+
+        decoded: list of (key, corr_seed, offsets) | None per report.
+        Returns per-report (PrepState, PrepShare) | None (host fallback).
+        """
+        import jax.numpy as jnp
+
+        from janus_tpu.ops import field64 as f64
+        from janus_tpu.ops import xof_batch
+        from janus_tpu.ops.idpf_batch import eval_inner_level, pack_prefix_bits
+
+        level, prefixes = self.vdaf._bound()
+        P = len(prefixes)
+        idx = [i for i, d in enumerate(decoded) if d is not None]
+        if not idx:
+            return [None] * len(decoded)
+        from janus_tpu.engine.batch import bucket_size
+
+        # pad to a bucket so compiled executables are bounded per (P, level)
+        N = bucket_size(len(idx))
+        n_levels = level + 1
+
+        fixed = np.zeros((N, 16), dtype=np.uint8)
+        seeds = np.zeros((N, 16), dtype=np.uint8)
+        cw_seeds = np.zeros((n_levels, N, 16), dtype=np.uint8)
+        cw_ctrls = np.zeros((n_levels, N, 2), dtype=np.uint8)
+        payload = np.zeros((2, N), dtype=np.uint32)
+        corr_seeds = np.zeros((N, 16), dtype=np.uint8)
+        offs = np.zeros((2, 3, N), dtype=np.uint32)
+        nonce_rows = np.zeros((N, 16), dtype=np.uint8)
+        for k, i in enumerate(idx):
+            key, corr_seed, offsets = decoded[i]
+            nonce = nonces[i]
+            fixed[k] = np.frombuffer(
+                _idpf._fixed_key(nonce, b"janus-tpu idpf"), dtype=np.uint8)
+            seeds[k] = np.frombuffer(key.seed, dtype=np.uint8)
+            nonce_rows[k] = np.frombuffer(nonce, dtype=np.uint8)
+            for lv in range(n_levels):
+                cs, cl, cr = key.seed_cws[lv]
+                cw_seeds[lv, k] = np.frombuffer(cs, dtype=np.uint8)
+                cw_ctrls[lv, k] = (cl, cr)
+            pcw = key.payload_cws[level][0]
+            payload[0, k] = pcw & 0xFFFFFFFF
+            payload[1, k] = pcw >> 32
+            corr_seeds[k] = np.frombuffer(corr_seed, dtype=np.uint8)
+            if offsets is not None:
+                for j, v in enumerate(offsets[level]):
+                    offs[0, j, k] = v & 0xFFFFFFFF
+                    offs[1, j, k] = v >> 32
+        prefix_bits = pack_prefix_bits(prefixes, level, n_levels)
+        party = agg_id == 1
+
+        fn_key = (N, P, level, party, verify_key)
+        fn = self._fns.get(fn_key)
+        if fn is None:
+            import jax
+
+            vdaf = self.vdaf
+            vk = verify_key
+            binder_static = (level.to_bytes(2, "big")
+                            + P.to_bytes(4, "big"))
+
+            def kernel(fixed, seeds, cw_seeds, cw_ctrls, payload, corr_seeds,
+                       offs, nonce_rows, pb):
+                parties = jnp.full((N,), party, dtype=bool)
+                ys = eval_inner_level(fixed, seeds, parties, cw_seeds,
+                                      cw_ctrls, payload, pb, level, P)
+                rs, rej1 = xof_batch.expand_field64(
+                    (N,), [xof_batch.xof_prefix(b"poplar1 query", vk),
+                           nonce_rows, binder_static], P)
+                corr, rej2 = xof_batch.expand_field64(
+                    (N,), [xof_batch.xof_prefix(b"poplar1 corr"), corr_seeds,
+                           level.to_bytes(2, "big")], 3)
+                abc = f64.add(corr, offs)  # [2, 3, N]
+                a_s, c_s = abc[:, 0], abc[:, 2]
+                z = f64.sum_mod(f64.mul(rs, ys), axis=-2)
+                zs = f64.sum_mod(f64.mul(f64.mul(rs, rs), ys), axis=-2)
+                zc = f64.sum_mod(ys, axis=-2)
+                r1 = jnp.stack(
+                    [f64.add(z, a_s), f64.add(zs, c_s), zc], axis=1)
+                return ys, abc, r1, rej1 | rej2
+
+            fn = jax.jit(kernel)
+            self._fns[fn_key] = fn
+
+        ys_d, abc_d, r1_d, rej_d = fn(fixed, seeds, cw_seeds, cw_ctrls,
+                                      payload, corr_seeds, offs, nonce_rows,
+                                      prefix_bits)
+        ys = np.asarray(ys_d)
+        abc = np.asarray(abc_d)
+        r1 = np.asarray(r1_d)
+        rej = np.asarray(rej_d)
+        ys64 = ys[0].astype(np.uint64) | (ys[1].astype(np.uint64) << 32)
+        abc64 = abc[0].astype(np.uint64) | (abc[1].astype(np.uint64) << 32)
+        r164 = r1[0].astype(np.uint64) | (r1[1].astype(np.uint64) << 32)
+
+        out: list = [None] * len(decoded)
+        for k, i in enumerate(idx):
+            if rej[k]:
+                self.fallback_count += 1
+                continue  # host fallback (XOF rejection lane)
+            state = PrepState([int(v) for v in ys64[:, k]], None)
+            state.poplar = (agg_id, level, int(abc64[0, k]),
+                            int(abc64[1, k]), int(abc64[2, k]))
+            share = PrepShare(None, [int(v) for v in r164[:, k]])
+            out[i] = (state, share)
+        return out
+
+    # -- engine surface ----------------------------------------------------
+
+    def helper_init_batch(self, verify_key, nonces, public_shares,
+                          input_shares, inbound_messages):
+        if not self._device_eligible() or len(nonces) < self.device_min_batch:
+            return super().helper_init_batch(
+                verify_key, nonces, public_shares, input_shares,
+                inbound_messages)
+        from janus_tpu.engine.batch import PreparedReport
+
+        decoded = []
+        errors: dict[int, str] = {}
+        for i, (pub, in_bytes) in enumerate(zip(public_shares, input_shares)):
+            try:
+                self.vdaf.decode_public_share(pub)
+                decoded.append(self.vdaf.decode_input_share(1, in_bytes))
+            except (VdafError, ValueError, AssertionError) as e:
+                errors[i] = str(e)
+                decoded.append(None)
+        cached = self._precompute(verify_key, 1, nonces, decoded)
+        out = []
+        for i, inbound in enumerate(inbound_messages):
+            if i in errors:
+                out.append(PreparedReport("failed", error=errors[i]))
+                continue
+            if cached[i] is None:
+                out.extend(super().helper_init_batch(
+                    verify_key, nonces[i : i + 1], public_shares[i : i + 1],
+                    input_shares[i : i + 1], [inbound]))
+                continue
+            shim = _CachedPrepVdaf(self.vdaf, cached[i])
+            try:
+                transition = ping_pong.helper_initialized(
+                    shim, verify_key, nonces[i], b"", decoded[i], inbound)
+                state, outbound = transition.evaluate()
+                if state.finished:
+                    out.append(PreparedReport(
+                        "finished", outbound=outbound,
+                        out_share_raw=state.out_share))
+                else:
+                    out.append(PreparedReport(
+                        "continued", outbound=outbound, state=state,
+                        prep_share=self.vdaf.encode_prep_state(
+                            state.prep_state, state.current_round)))
+            except (VdafError, ValueError, AssertionError) as e:
+                out.append(PreparedReport("failed", error=str(e)))
+        return out
+
+    def leader_init_batch(self, verify_key, nonces, public_shares,
+                          input_shares):
+        if not self._device_eligible() or len(nonces) < self.device_min_batch:
+            return super().leader_init_batch(
+                verify_key, nonces, public_shares, input_shares)
+        from janus_tpu.engine.batch import PreparedReport
+
+        decoded = []
+        errors: dict[int, str] = {}
+        for i, (pub, in_bytes) in enumerate(zip(public_shares, input_shares)):
+            try:
+                self.vdaf.decode_public_share(pub)
+                decoded.append(self.vdaf.decode_input_share(0, in_bytes))
+            except (VdafError, ValueError, AssertionError) as e:
+                errors[i] = str(e)
+                decoded.append(None)
+        cached = self._precompute(verify_key, 0, nonces, decoded)
+        out = []
+        for i in range(len(nonces)):
+            if i in errors:
+                out.append(PreparedReport("failed", error=errors[i]))
+                continue
+            if cached[i] is None:
+                out.extend(super().leader_init_batch(
+                    verify_key, nonces[i : i + 1], public_shares[i : i + 1],
+                    input_shares[i : i + 1]))
+                continue
+            shim = _CachedPrepVdaf(self.vdaf, cached[i])
+            try:
+                state, outbound = ping_pong.leader_initialized(
+                    shim, verify_key, nonces[i], b"", decoded[i])
+                out.append(PreparedReport(
+                    "continued", outbound=outbound, state=state,
+                    out_share_raw=state.prep_state.out_share,
+                    prep_share=outbound.prep_share))
+            except (VdafError, ValueError, AssertionError) as e:
+                out.append(PreparedReport("failed", error=str(e)))
+        return out

@@ -195,9 +195,14 @@ def prep_engine(inst: VdafInstance):
                 from janus_tpu.engine import BatchPrio3
 
                 engine = BatchPrio3(vdaf)
+            elif inst.kind == "Poplar1":
+                # batched IDPF walk + sketch on device (inner levels;
+                # the Field255 leaf level falls back to the host oracle)
+                from janus_tpu.engine.batch_poplar1 import BatchPoplar1
+
+                engine = BatchPoplar1(vdaf)
             else:
-                # Fake* and Poplar1 run the per-report oracle on the host
-                # (Poplar1 IDPF device kernels are future work).
+                # Fake* test VDAFs run the per-report oracle on the host
                 from janus_tpu.engine.host import HostPrepEngine
 
                 engine = HostPrepEngine(vdaf)

@@ -396,6 +396,35 @@ def _ctr_counters(iv, n_blocks: int):
     return _be_words_to_bytes(counters)  # [N, n_blocks, 16]
 
 
+def make_key_planes(rks):
+    """Round keys u8 [N, 11, 16] -> list of 11 per-round plane lists
+    (each 8 x [16, N, 1]) for aes128_encrypt_planes."""
+    return [_key_planes(rks[:, r]) for r in range(11)]
+
+
+def aes128_encrypt_planes(planes, rkp):
+    """Bitsliced AES-128 block encryption on plane state.
+
+    planes: 8 x [16, N, B] u32 (bit b of byte position p, 32 packed lanes
+    per word); rkp: make_key_planes output.  Returns the encrypted planes.
+    Shared by the CTR keystream and the IDPF tree walk
+    (janus_tpu.ops.idpf_batch)."""
+    state = [s ^ k for s, k in zip(planes, rkp[0])]
+    # stack mid-round keys per plane for scan: [9, 16, N, 1]
+    xs = [jnp.stack([rkp[r][b] for r in range(1, 10)], axis=0)
+          for b in range(8)]
+
+    def round_fn(st, rk):
+        st = _bs_sbox(list(st))
+        st = _bs_mix_shift(st)
+        return tuple(p ^ k for p, k in zip(st, rk)), None
+
+    state, _ = jax.lax.scan(round_fn, tuple(state), tuple(xs))
+    state = _bs_sbox(list(state))
+    state = _bs_shift_rows(state)
+    return [s ^ k for s, k in zip(state, rkp[10])]
+
+
 def aes128_ctr_words(key, iv, n_words: int):
     """Batched bitsliced AES-128-CTR keystream as little-endian u32 words.
 
@@ -406,24 +435,7 @@ def aes128_ctr_words(key, iv, n_words: int):
     B = -(-n_blocks // 32)
     rks = aes128_key_schedule(key)  # [N, 11, 16]
     state = _pack_block_bits(_ctr_counters(iv, n_blocks), 32 * B)
-    k0 = _key_planes(rks[:, 0])
-    state = [s ^ k for s, k in zip(state, k0)]
-
-    mid_planes = [_key_planes(rks[:, r]) for r in range(1, 10)]
-    # stack per plane for scan: [9, 16, N, 1]
-    xs = [jnp.stack([mid_planes[r][b] for r in range(9)], axis=0)
-          for b in range(8)]
-
-    def round_fn(planes, rk_planes):
-        planes = _bs_sbox(list(planes))
-        planes = _bs_mix_shift(planes)
-        return tuple(p ^ k for p, k in zip(planes, rk_planes)), None
-
-    state, _ = jax.lax.scan(round_fn, tuple(state), tuple(xs))
-    state = _bs_sbox(list(state))
-    state = _bs_shift_rows(state)
-    k10 = _key_planes(rks[:, 10])
-    state = [s ^ k for s, k in zip(state, k10)]
+    state = aes128_encrypt_planes(state, make_key_planes(rks))
     words = _planes_to_words(state)  # [4, N, 32B]
     # word j of block k sits at stream position 4k + j
     stream = jnp.transpose(words, (2, 0, 1)).reshape(4 * 32 * B, N)

@@ -1,0 +1,176 @@
+"""Batched IDPF evaluation — the Poplar1 prepare hot loop on device.
+
+The reference evaluates its IDPF sequentially per (report, prefix) inside
+prio's poplar1 module (consumed via core/src/vdaf.rs:95); here the whole
+(reports x candidate prefixes) grid walks the tree at once:
+
+- Lanes are (report, prefix) pairs: prefix lanes pack 32-per-u32-word, so
+  all tensors are the bitsliced-AES plane shape [16, N, B] of
+  janus_tpu.ops.hmac_aes (B = ceil(num_prefixes / 32)); the per-report
+  fixed AES key broadcasts over the prefix words exactly like the CTR
+  round keys.
+- The PRG is the oracle's tweaked fixed-key Davies-Meyer AES
+  (janus_tpu.vdaf.idpf._Prg): per level each lane runs 4 block encryptions
+  (two child seeds + control block + convert seed), with the tweaks applied
+  as trace-time plane masks — no hashes, no counter carries, no gathers.
+- Seed/control correction words, child selection by prefix bit, and the
+  final payload correction are masked XOR/field ops in plane space.
+- Only INNER levels (Field64 payloads) run on device; the leaf level
+  (Field255) takes the host oracle path in the engine.
+
+Field64 candidates never reject (the oracle clears the top bit of each
+8-byte chunk, and 2^63 < p), so the walk output is bit-exact with the
+oracle with no fallback lanes.
+
+Validated against janus_tpu.vdaf.idpf in tests/test_idpf_batch.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.ops.hmac_aes import (
+    _pack_block_bits,
+    _planes_to_words,
+    aes128_encrypt_planes,
+    aes128_key_schedule,
+    make_key_planes,
+)
+from janus_tpu.vdaf.idpf import LABEL_CONVERT, LABEL_EXTEND, prg_tweak
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+
+
+def _tweak_masks(label: int, level: int, j: int):
+    """The 16-byte PRG tweak as plane XOR masks: list of 8 entries, each a
+    [16, 1, 1] u32 word that is all-ones where the tweak bit is set."""
+    t = np.frombuffer(prg_tweak(label, level, j), dtype=np.uint8)
+    masks = []
+    for b in range(8):
+        bits = ((t >> b) & 1).astype(np.uint32)
+        masks.append(jnp.asarray((0 - bits) & 0xFFFFFFFF).reshape(16, 1, 1))
+    return masks
+
+
+def _xor_tweak(planes, masks):
+    return [p ^ m for p, m in zip(planes, masks)]
+
+
+def _prg_block_planes(seed_planes, rkp, label: int, level: int, j: int):
+    """G_j(s) = AES_k(s ⊕ T) ⊕ s ⊕ T on plane state."""
+    x = _xor_tweak(seed_planes, _tweak_masks(label, level, j))
+    enc = aes128_encrypt_planes(x, rkp)
+    return [a ^ b for a, b in zip(enc, x)]
+
+
+def _full_words(bits):
+    """u8/bool array [N, k] -> all-ones/zeros u32 words [k?, N, 1]."""
+    w = (jnp.asarray(bits, dtype=_U32))
+    return (_U32(0) - w)
+
+
+def pack_prefix_bits(prefixes, level: int, n_levels: int) -> np.ndarray:
+    """Host: prefix list -> per-level packed selection words [n_levels, B].
+
+    Bit k of word w at level lv = bit (level - lv) of prefix 32w + k (the
+    oracle's `(prefix >> (level - lv)) & 1`)."""
+    P = len(prefixes)
+    B = -(-P // 32)
+    pre = np.asarray([int(p) for p in prefixes], dtype=np.uint64)
+    shifts = (level - np.arange(n_levels, dtype=np.uint64))[:, None]
+    bits = ((pre[None, :] >> shifts) & 1).astype(np.uint32)  # [n_levels, P]
+    padded = np.zeros((n_levels, B * 32), dtype=np.uint32)
+    padded[:, :P] = bits
+    weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))
+    return (padded.reshape(n_levels, B, 32) * weights).sum(
+        axis=2, dtype=np.uint32)
+
+
+def eval_inner_level(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
+                     payload_cws, prefix_bits, level: int, num_prefixes: int):
+    """Evaluate every (report, prefix) pair at an inner (Field64) level.
+
+    fixed_keys: u8 [N, 16] per-report fixed AES keys
+    seeds:      u8 [N, 16] per-report root key seeds
+    parties:    bool [N] (True = party 1 negates its outputs)
+    cw_seeds:   u8 [n_levels, N, 16] per-level seed correction words
+    cw_ctrls:   u8 [n_levels, N, 2] (ctrl_l, ctrl_r) correction bits
+    payload_cws: u32 [2, N] Field64 limb pair of the level's payload cw
+                 (value_len = 1, Poplar1's shape)
+    prefix_bits: u32 [n_levels, B] packed per-level prefix selection words
+    level:      target level; n_levels = level + 1 walk steps
+    -> ys raw limbs [2, P, N] (P = num_prefixes), bit-exact with
+       Idpf.eval(...) per lane.
+    """
+    N = seeds.shape[0]
+    n_levels = level + 1
+    B = prefix_bits.shape[1]
+    rkp = make_key_planes(aes128_key_schedule(fixed_keys))
+
+    # initial state: every lane of a report carries the same root seed/ctrl
+    seed_rows = jnp.broadcast_to(jnp.asarray(seeds)[:, None, :], (N, B * 32, 16))
+    state = _pack_block_bits(seed_rows, 32 * B)  # 8 x [16, N, B]
+    ctrl = jnp.broadcast_to(
+        _full_words(jnp.asarray(parties, dtype=_U32))[:, None], (N, B))
+
+    cw_seed_planes_all = []
+    for lv in range(n_levels):
+        rows = jnp.asarray(cw_seeds[lv])[:, None, :]  # [N, 1, 16]
+        cw_seed_planes_all.append(_pack_block_bits(
+            jnp.broadcast_to(rows, (N, 32, 16)), 32))
+        # -> planes [16, N, 1]: all 32 packed lanes carry the same cw word
+    cwl = _full_words(jnp.asarray(cw_ctrls)[..., 0])  # [n_levels, N]
+    cwr = _full_words(jnp.asarray(cw_ctrls)[..., 1])
+
+    for lv in range(n_levels):
+        pb = jnp.asarray(prefix_bits[lv])[None, :]  # [1, B] packed prefix bit
+        s_l = _prg_block_planes(state, rkp, LABEL_EXTEND, lv, 0)
+        s_r = _prg_block_planes(state, rkp, LABEL_EXTEND, lv, 1)
+        cb = _prg_block_planes(state, rkp, LABEL_EXTEND, lv, 2)
+        # child select by prefix bit
+        nxt = [(l & ~pb) | (r & pb) for l, r in zip(s_l, s_r)]
+        # control bits: lsb of bytes 0 / 1 of the control block -> spread the
+        # packed bit-0 plane words for byte 0 (left) and byte 1 (right)
+        t_l = cb[0][0]  # [N, B]: bit0 plane, byte position 0
+        t_r = cb[0][1]
+        t = (t_l & ~pb) | (t_r & pb)
+        # correction where the parent control bit is set
+        cw_p = cw_seed_planes_all[lv]
+        nxt = [s ^ (c & ctrl) for s, c in zip(nxt, cw_p)]
+        cw_ctrl_sel = (cwl[lv][:, None] & ~pb) | (cwr[lv][:, None] & pb)
+        t = t ^ (cw_ctrl_sel & ctrl)
+        # convert: block 0 is the next seed (not needed past the last level)
+        if lv < level:
+            state = _prg_block_planes(nxt, rkp, LABEL_CONVERT, lv, 0)
+        ctrl = t
+        if lv == level:
+            # value block: candidate = first 8 bytes of block j=1 of the
+            # CONVERT stream keyed by the PRE-convert seed `nxt`
+            vb = _prg_block_planes(nxt, rkp, LABEL_CONVERT, lv, 1)
+            words = _planes_to_words(vb)  # [4, N, 32B] LE words
+            lo = words[0]  # [N, 32B]
+            hi = words[1] & _U32(0x7FFFFFFF)  # oracle clears the chunk's top bit
+            ys = jnp.stack([jnp.transpose(lo, (1, 0)),
+                            jnp.transpose(hi, (1, 0))], axis=0)  # [2, 32B, N]
+            ys = ys[:, :num_prefixes]
+
+    from janus_tpu.ops import field64 as f64
+
+    # payload correction where the final control bit is set, then party sign
+    ctrl_bits = _unpack_bits(ctrl, num_prefixes)  # bool [P, N]
+    corrected = f64.add(ys, jnp.asarray(payload_cws)[:, None, :])
+    ys = f64.select(ctrl_bits, corrected, ys)
+    neg = f64.neg(ys)
+    party_b = jnp.asarray(parties, dtype=bool)[None, :]  # [1, N] -> [P, N]
+    ys = f64.select(jnp.broadcast_to(party_b, ctrl_bits.shape), neg, ys)
+    return ys
+
+
+def _unpack_bits(words, n: int):
+    """Packed bool words [N, B] -> bool [n, N] (bit k of word w = lane 32w+k)."""
+    N, B = words.shape
+    bits = (words[:, :, None] >> jnp.arange(32, dtype=_U32)) & _U32(1)
+    return jnp.transpose(bits.reshape(N, 32 * B), (1, 0)).astype(bool)[:n]

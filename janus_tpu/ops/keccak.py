@@ -29,6 +29,8 @@ validated against hashlib's SHAKE128 and the TurboSHAKE128 KAT).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -102,9 +104,32 @@ def _round_lanes(los, his, rc):
     return los, his
 
 
-def _permute_lanes(los, his, rounds: int = 12):
-    """Keccak-p on unrolled lane lists (each entry shape = batch)."""
+@functools.lru_cache(maxsize=1)
+def _unroll_ok() -> bool:
+    """Round unrolling trades compile time for runtime: a win on TPU (the
+    runtime charges a fixed per-scan-iteration cost ~100x the round's
+    arithmetic) but XLA:CPU chokes for minutes on the 1.5k-op straight-line
+    bodies, so tests keep the nested scan."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def _permute_lanes(los, his, rounds: int = 12, unroll: bool = False):
+    """Keccak-p on unrolled lane lists (each entry shape = batch).
+
+    `unroll=True` requests straight-line rounds (see _unroll_ok) — used
+    inside outer block scans, where a nested 12-iteration scan would pay the
+    per-iteration runtime cost on every round of every block."""
     assert 1 <= rounds <= 24, "Keccak-p[1600] round count must be in [1, 24]"
+    if unroll and _unroll_ok():
+        rcs = _RC_LIMBS[24 - rounds:]
+        for k in range(rounds):
+            rc = (jnp.asarray(np.uint32(rcs[k, 0])),
+                  jnp.asarray(np.uint32(rcs[k, 1])))
+            los, his = _round_lanes(list(los), list(his), rc)
+        return list(los), list(his)
     rcs = jnp.asarray(_RC_LIMBS[24 - rounds:])
 
     def step(st, rc):
@@ -154,7 +179,7 @@ def _absorb_lanes(blocks, rounds: int = 12):
         for j in range(RATE_LANES):
             los[j] = los[j] ^ blo[0, j]
             his[j] = his[j] ^ bhi[0, j]
-        return _permute_lanes(los, his, rounds)
+        return _permute_lanes(los, his, rounds, unroll=True)
 
     def step(st, blk):
         lo = list(st[0])
@@ -163,7 +188,7 @@ def _absorb_lanes(blocks, rounds: int = 12):
         for j in range(RATE_LANES):
             lo[j] = lo[j] ^ bl[j]
             hi[j] = hi[j] ^ bh[j]
-        lo, hi = _permute_lanes(lo, hi, rounds)
+        lo, hi = _permute_lanes(lo, hi, rounds, unroll=True)
         return (tuple(lo), tuple(hi)), None
 
     (los, his), _ = jax.lax.scan(step, (tuple(los), tuple(his)), (blo, bhi))
@@ -178,13 +203,13 @@ def _squeeze_lanes_scan(los, his, n_lanes: int, rounds: int):
     if nblocks_out == 1:
         out_lo = jnp.stack(los[:n_lanes], axis=0)
         out_hi = jnp.stack(his[:n_lanes], axis=0)
-        los, his = _permute_lanes(los, his, rounds)
+        los, his = _permute_lanes(los, his, rounds, unroll=True)
         return out_lo, out_hi, los, his
 
     def step(st, _):
         lo, hi = st
         ys = (lo[:RATE_LANES], hi[:RATE_LANES])
-        nlo, nhi = _permute_lanes(list(lo), list(hi), rounds)
+        nlo, nhi = _permute_lanes(list(lo), list(hi), rounds, unroll=True)
         return (tuple(nlo), tuple(nhi)), ys
 
     (flo, fhi), (ys_lo, ys_hi) = jax.lax.scan(
@@ -210,7 +235,8 @@ def squeeze(state, n_lanes: int, rounds: int = 12):
     lo, hi = state
     out_lo, out_hi, flo, fhi = _squeeze_lanes_scan(
         [lo[i] for i in range(25)], [hi[i] for i in range(25)], n_lanes, rounds)
-    return (out_lo, out_hi), (jnp.stack(flo, axis=0), jnp.stack(fhi, axis=0))
+    return ((out_lo, out_hi),
+            (jnp.stack(flo, axis=0), jnp.stack(fhi, axis=0)))
 
 
 def absorb_squeeze(blocks, n_lanes: int, rounds: int = 12):

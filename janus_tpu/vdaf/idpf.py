@@ -7,8 +7,13 @@ produces two keys that, evaluated at any prefix of the programmed point
 every other prefix.  Inner levels carry Field64 pairs, the leaf level
 Field255 pairs (value, authenticator).
 
-The PRG is AES-128 with a fixed key acting as an extend/convert function
-(cheap per-node expansion; the fixed key is derived once per (nonce, dst)).
+The PRG is a fixed-key AES-128 tweaked Davies-Meyer construction
+(G_j(s) = AES_k(s ⊕ T_j) ⊕ s ⊕ T_j, with the fixed key derived once per
+(nonce, dst) — the same shape as the VDAF draft's XofFixedKeyAes128):
+every per-node operation is exactly one AES block whose input is the seed
+XOR a trace-time tweak constant.  No hashes and no counter carries appear in
+the tree walk, which is what lets the device kernel (janus_tpu.ops.
+idpf_batch) run the whole walk bitsliced over (reports x prefixes) lanes.
 Correctness property (pinned in tests/test_poplar1.py): for every level L and
 candidate prefix p,  Eval(key0, p) + Eval(key1, p) == beta_L if p is a
 prefix of alpha else 0.
@@ -38,46 +43,59 @@ class Field255(Field):
 KEY_SIZE = 16
 RAND_SIZE = 2 * KEY_SIZE
 
+LABEL_EXTEND = 0
+LABEL_CONVERT = 1
+
 
 def _fixed_key(nonce: bytes, dst: bytes) -> bytes:
     return hashlib.sha256(b"idpf fixed key" + bytes([len(dst)]) + dst
                           + nonce).digest()[:16]
 
 
+def prg_tweak(label: int, level: int, j: int) -> bytes:
+    """16-byte tweak: label || level_be16 || j_be32 || zeros."""
+    return (bytes([label]) + level.to_bytes(2, "big") + j.to_bytes(4, "big")
+            + bytes(9))
+
+
 class _Prg:
-    """Fixed-key AES-based node expansion."""
+    """Fixed-key AES node expansion: G_j(s) = AES_k(s ⊕ T_j) ⊕ s ⊕ T_j."""
 
     def __init__(self, nonce: bytes, dst: bytes):
         self._key = _fixed_key(nonce, dst)
 
-    def _block(self, seed: bytes, label: bytes) -> bytes:
-        # CTR over a seed-derived IV: 2 blocks per call
-        iv = hashlib.sha256(seed + label).digest()[:16]
-        enc = Cipher(algorithms.AES(self._key), modes.CTR(iv)).encryptor()
-        return enc.update(bytes(32))
+    def _block(self, seed: bytes, label: int, level: int, j: int) -> bytes:
+        t = prg_tweak(label, level, j)
+        x = bytes(a ^ b for a, b in zip(seed, t))
+        enc = Cipher(algorithms.AES(self._key), modes.ECB()).encryptor()
+        out = enc.update(x)
+        return bytes(a ^ b for a, b in zip(out, x))
 
-    def extend(self, seed: bytes) -> tuple[bytes, int, bytes, int]:
-        """seed -> (seed_left, ctrl_left, seed_right, ctrl_right)."""
-        out_l = self._block(seed, b"L")
-        out_r = self._block(seed, b"R")
-        return out_l[:16], out_l[16] & 1, out_r[:16], out_r[16] & 1
+    def extend(self, seed: bytes, level: int) -> tuple[bytes, int, bytes, int]:
+        """seed -> (seed_left, ctrl_left, seed_right, ctrl_right).
+
+        Three AES blocks: the two child seeds plus a control block whose
+        first two byte-lsbs are the control bits."""
+        s_l = self._block(seed, LABEL_EXTEND, level, 0)
+        s_r = self._block(seed, LABEL_EXTEND, level, 1)
+        ctrl = self._block(seed, LABEL_EXTEND, level, 2)
+        return s_l, ctrl[0] & 1, s_r, ctrl[1] & 1
 
     def convert(self, seed: bytes, field: type[Field], n: int,
                 level: int) -> tuple[bytes, list[int]]:
-        """seed -> (next seed, n field elements)."""
-        stream = self._block(seed, b"C" + level.to_bytes(2, "big"))
-        next_seed = stream[:16]
-        out = []
-        counter = 0
+        """seed -> (next seed, n field elements).
+
+        Block 0 is the next seed; the value stream is blocks 1, 2, ...
+        consumed as little-endian ENCODED_SIZE chunks with rejection
+        sampling (top bit cleared first, as the Field255 sign bit)."""
+        next_seed = self._block(seed, LABEL_CONVERT, level, 0)
+        out: list[int] = []
+        j = 1
         buf = b""
         while len(out) < n:
-            if len(buf) < field.ENCODED_SIZE:
-                iv = hashlib.sha256(seed + b"V" + level.to_bytes(2, "big")
-                                    + counter.to_bytes(4, "big")).digest()[:16]
-                enc = Cipher(algorithms.AES(self._key),
-                             modes.CTR(iv)).encryptor()
-                buf += enc.update(bytes(64))
-                counter += 1
+            while len(buf) < field.ENCODED_SIZE:
+                buf += self._block(seed, LABEL_CONVERT, level, j)
+                j += 1
             x = int.from_bytes(buf[: field.ENCODED_SIZE], "little")
             buf = buf[field.ENCODED_SIZE:]
             x &= (1 << (8 * field.ENCODED_SIZE - 1)) - 1  # clear top bit
@@ -157,7 +175,7 @@ class Idpf:
         for level in range(self.bits):
             f = self._field(level)
             bit = (alpha >> (self.bits - 1 - level)) & 1
-            ext = [self.prg.extend(seeds[0]), self.prg.extend(seeds[1])]
+            ext = [self.prg.extend(seeds[0], level), self.prg.extend(seeds[1], level)]
             # (seed_l, ctrl_l, seed_r, ctrl_r) per party
             keep, lose = (2, 0) if bit else (0, 2)
             cw_seed = bytes(a ^ b for a, b in zip(ext[0][lose], ext[1][lose]))
@@ -203,7 +221,7 @@ class Idpf:
         for lv in range(level + 1):
             f = self._field(lv)
             bit = (prefix >> (level - lv)) & 1
-            s_l, t_l, s_r, t_r = self.prg.extend(seed)
+            s_l, t_l, s_r, t_r = self.prg.extend(seed, lv)
             s, t = (s_r, t_r) if bit else (s_l, t_l)
             cw_seed, cw_ctrl_l, cw_ctrl_r = key.seed_cws[lv]
             if ctrl:

@@ -1,0 +1,152 @@
+"""Device IDPF walk + BatchPoplar1 vs the host oracle, bit for bit."""
+
+import numpy as np
+import pytest
+
+from janus_tpu.engine.batch_poplar1 import BatchPoplar1
+from janus_tpu.engine.host import HostPrepEngine
+from janus_tpu.ops.idpf_batch import eval_inner_level, pack_prefix_bits
+from janus_tpu.vdaf import idpf as idpf_mod
+from janus_tpu.vdaf import ping_pong
+from janus_tpu.vdaf.idpf import Idpf
+from janus_tpu.vdaf.poplar1 import encode_agg_param, new_poplar1
+
+
+def _keys(bits, n, value_len=1):
+    keys0, keys1, idpfs, nonces = [], [], [], []
+    for i in range(n):
+        nonce = (i * 7 + 1).to_bytes(16, "big")
+        d = Idpf(bits, value_len, nonce)
+        alpha = (i * 37) % (1 << bits)
+        betas = [[1] for _ in range(bits)]
+        rand = bytes((i + j) % 256 for j in range(idpf_mod.RAND_SIZE))
+        k0, k1 = d.gen(alpha, betas, rand)
+        keys0.append(k0)
+        keys1.append(k1)
+        idpfs.append(d)
+        nonces.append(nonce)
+    return keys0, keys1, idpfs, nonces
+
+
+@pytest.mark.parametrize("level,prefixes", [
+    (0, [0, 1]),
+    (2, [0, 3, 5, 7]),
+    (5, [1, 9, 33, 63, 40, 41, 42]),
+    # > 32 prefixes: exercises the multi-word packed axis (B > 1)
+    (5, list(range(40))),
+])
+def test_eval_inner_level_matches_oracle(level, prefixes):
+    bits = 8
+    n = 5
+    for party in (0, 1):
+        keys0, keys1, idpfs, nonces = _keys(bits, n)
+        keys = keys0 if party == 0 else keys1
+        N = n
+        fixed = np.stack([
+            np.frombuffer(idpf_mod._fixed_key(nc, b"janus-tpu idpf"),
+                          dtype=np.uint8) for nc in nonces])
+        seeds = np.stack([np.frombuffer(k.seed, dtype=np.uint8) for k in keys])
+        n_levels = level + 1
+        cw_seeds = np.zeros((n_levels, N, 16), dtype=np.uint8)
+        cw_ctrls = np.zeros((n_levels, N, 2), dtype=np.uint8)
+        payload = np.zeros((2, N), dtype=np.uint32)
+        for k_i, key in enumerate(keys):
+            for lv in range(n_levels):
+                cs, cl, cr = key.seed_cws[lv]
+                cw_seeds[lv, k_i] = np.frombuffer(cs, dtype=np.uint8)
+                cw_ctrls[lv, k_i] = (cl, cr)
+            pcw = key.payload_cws[level][0]
+            payload[0, k_i] = pcw & 0xFFFFFFFF
+            payload[1, k_i] = pcw >> 32
+        pb = pack_prefix_bits(prefixes, level, n_levels)
+        parties = np.full((N,), bool(party))
+        ys = np.asarray(eval_inner_level(
+            fixed, seeds, parties, cw_seeds, cw_ctrls, payload, pb, level,
+            len(prefixes)))
+        ys64 = ys[0].astype(np.uint64) | (ys[1].astype(np.uint64) << 32)
+        for k_i, key in enumerate(keys):
+            want = [v[0] for v in idpfs[k_i].eval(key, level, list(prefixes))]
+            got = [int(v) for v in ys64[:, k_i]]
+            assert got == want, f"party={party} report={k_i}"
+
+
+def test_idpf_shares_combine():
+    # sanity on the oracle itself with the fixed-key AES PRG
+    bits = 6
+    keys0, keys1, idpfs, _ = _keys(bits, 3)
+    d = idpfs[0]
+    level = 3
+    prefixes = list(range(1 << (level + 1)))
+    from janus_tpu.vdaf.field_ref import Field64
+
+    y0 = d.eval(keys0[0], level, prefixes)
+    y1 = d.eval(keys1[0], level, prefixes)
+    alpha_prefix = (0 * 37) >> (bits - 1 - level)
+    for p in prefixes:
+        tot = Field64.add(y0[p][0], y1[p][0])
+        assert tot == (1 if p == alpha_prefix else 0)
+
+
+def test_batch_poplar1_matches_host_engine():
+    vdaf = new_poplar1(8)
+    level, prefixes = 4, [0, 3, 7, 21, 30, 31]
+    ap = encode_agg_param(level, prefixes)
+    verify_key = bytes(range(16))
+
+    nonces, pubs, shares0, shares1, inits = [], [], [], [], []
+    host = HostPrepEngine(vdaf).bind(ap)
+    dev = BatchPoplar1(vdaf, device_min_batch=1).bind(ap)
+    for i in range(7):
+        nonce = (i + 1).to_bytes(16, "big")
+        meas = (i * 31) % 256
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard(meas, nonce, rand)
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares0.append(vdaf.encode_input_share(0, ishares[0]))
+        shares1.append(vdaf.encode_input_share(1, ishares[1]))
+
+    # leader init: identical wire messages and states
+    res_d = dev.leader_init_batch(verify_key, nonces, pubs, shares0)
+    res_h = host.leader_init_batch(verify_key, nonces, pubs, shares0)
+    for a, b in zip(res_d, res_h):
+        assert a.status == b.status == "continued"
+        assert a.outbound.encode() == b.outbound.encode()
+        assert a.state.prep_state.out_share == b.state.prep_state.out_share
+        assert a.state.prep_state.poplar == b.state.prep_state.poplar
+        inits.append(a.outbound)
+
+    # helper init: identical outbound continue message + persisted state
+    res_dh = dev.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    res_hh = host.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    for a, b in zip(res_dh, res_hh):
+        assert a.status == b.status == "continued"
+        assert a.outbound.encode() == b.outbound.encode()
+        assert a.prep_share == b.prep_share
+
+    # drive the remaining rounds on the host: everything must verify
+    bound = vdaf.with_agg_param(ap)
+    finished = 0
+    for i in range(len(nonces)):
+        lead = res_d[i].state
+        t = ping_pong.continued(bound, lead, res_dh[i].outbound)
+        st, msg = t.evaluate()
+        helper_fin = ping_pong.continued(bound, res_dh[i].state, msg)
+        assert getattr(helper_fin, "finished", False) or helper_fin.prep_state
+        finished += 1
+    assert finished == len(nonces)
+
+
+def test_batch_poplar1_leaf_level_falls_back_to_host():
+    vdaf = new_poplar1(4)
+    ap = encode_agg_param(3, [0, 5, 15])  # leaf level (Field255)
+    dev = BatchPoplar1(vdaf, device_min_batch=1).bind(ap)
+    assert not dev._device_eligible()
+    verify_key = bytes(16)
+    nonce = bytes(range(16))
+    rand = bytes(j % 256 for j in range(vdaf.RAND_SIZE))
+    pub, ishares = vdaf.shard(9, nonce, rand)
+    res = dev.leader_init_batch(
+        verify_key, [nonce], [vdaf.encode_public_share(pub)],
+        [vdaf.encode_input_share(0, ishares[0])])
+    assert res[0].status == "continued"
