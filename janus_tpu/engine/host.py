@@ -34,7 +34,11 @@ class HostPrepEngine:
                           dtype=np.uint64).astype(np.uint32)
 
     def _raw_to_ints(self, raw) -> list[int]:
-        return [int(row[0]) | int(row[1]) << 32 for row in np.asarray(raw)]
+        raw = np.asarray(raw)  # [OUTPUT_LEN, LIMBS] little-endian u32 limbs
+        return [
+            sum(int(row[k]) << (32 * k) for k in range(raw.shape[-1]))
+            for row in raw
+        ]
 
     def helper_init_batch(self, verify_key, nonces, public_shares, input_shares,
                           inbound_messages) -> list[PreparedReport]:

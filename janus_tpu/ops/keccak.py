@@ -29,7 +29,6 @@ validated against hashlib's SHAKE128 and the TurboSHAKE128 KAT).
 
 from __future__ import annotations
 
-import functools
 
 import numpy as np
 
@@ -104,12 +103,14 @@ def _round_lanes(los, his, rc):
     return los, his
 
 
-@functools.lru_cache(maxsize=1)
 def _unroll_ok() -> bool:
     """Round unrolling trades compile time for runtime: a win on TPU (the
     runtime charges a fixed per-scan-iteration cost ~100x the round's
     arithmetic) but XLA:CPU chokes for minutes on the 1.5k-op straight-line
-    bodies, so tests keep the nested scan."""
+    bodies, so tests keep the nested scan.  Queried per call (NOT cached):
+    a process may initialize the TPU backend and later be forced onto a CPU
+    mesh (or vice versa), and a stale answer either disables the TPU fast
+    path for good or hands XLA:CPU the pathological straight-line body."""
     try:
         return jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover - backend init failure
