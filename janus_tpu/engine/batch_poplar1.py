@@ -127,23 +127,24 @@ class BatchPoplar1(HostPrepEngine):
         prefix_bits = pack_prefix_bits(prefixes, level, n_levels)
         party = agg_id == 1
 
-        fn_key = (N, P, level, party, verify_key)
+        # The verify key is a RUNTIME input (broadcast to a row per report):
+        # baking it into the closure would compile one executable per task
+        # with no eviction (one aggregator serves many tasks).
+        fn_key = (N, P, level, party)
         fn = self._fns.get(fn_key)
         if fn is None:
             import jax
 
-            vdaf = self.vdaf
-            vk = verify_key
             binder_static = (level.to_bytes(2, "big")
                             + P.to_bytes(4, "big"))
 
-            def kernel(fixed, seeds, cw_seeds, cw_ctrls, payload, corr_seeds,
-                       offs, nonce_rows, pb):
+            def kernel(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
+                       corr_seeds, offs, nonce_rows, pb):
                 parties = jnp.full((N,), party, dtype=bool)
                 ys = eval_inner_level(fixed, seeds, parties, cw_seeds,
                                       cw_ctrls, payload, pb, level, P)
                 rs, rej1 = xof_batch.expand_field64(
-                    (N,), [xof_batch.xof_prefix(b"poplar1 query", vk),
+                    (N,), [xof_batch.xof_prefix(b"poplar1 query"), vk_rows,
                            nonce_rows, binder_static], P)
                 corr, rej2 = xof_batch.expand_field64(
                     (N,), [xof_batch.xof_prefix(b"poplar1 corr"), corr_seeds,
@@ -160,9 +161,12 @@ class BatchPoplar1(HostPrepEngine):
             fn = jax.jit(kernel)
             self._fns[fn_key] = fn
 
-        ys_d, abc_d, r1_d, rej_d = fn(fixed, seeds, cw_seeds, cw_ctrls,
-                                      payload, corr_seeds, offs, nonce_rows,
-                                      prefix_bits)
+        vk_rows = np.broadcast_to(
+            np.frombuffer(verify_key, dtype=np.uint8),
+            (N, len(verify_key)))
+        ys_d, abc_d, r1_d, rej_d = fn(vk_rows, fixed, seeds, cw_seeds,
+                                      cw_ctrls, payload, corr_seeds, offs,
+                                      nonce_rows, prefix_bits)
         ys = np.asarray(ys_d)
         abc = np.asarray(abc_d)
         r1 = np.asarray(r1_d)

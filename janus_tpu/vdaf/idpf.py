@@ -48,7 +48,12 @@ LABEL_CONVERT = 1
 
 
 def _fixed_key(nonce: bytes, dst: bytes) -> bytes:
-    return hashlib.sha256(b"idpf fixed key" + bytes([len(dst)]) + dst
+    # "v2" marks the tweaked fixed-key Davies-Meyer PRG (round 2 redesign).
+    # The version in the derivation string makes shares produced under the
+    # earlier SHA-256-IV AES-CTR PRG *explicitly* incompatible: a mixed
+    # deployment fails key derivation loudly instead of silently rejecting
+    # every report as an invalid sketch.
+    return hashlib.sha256(b"janus-tpu idpf prg v2" + bytes([len(dst)]) + dst
                           + nonce).digest()[:16]
 
 

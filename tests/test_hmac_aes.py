@@ -76,3 +76,25 @@ def test_expand_field64_matches_oracle():
         # limbs are (2, n) + batch: limb-leading, batch minor
         got = [int(limbs[0, j, i]) | int(limbs[1, j, i]) << 32 for j in range(20)]
         assert got == want
+
+
+def test_bs_sbox_exhaustive_vs_table():
+    """All 256 inputs through the derived GF(2^8) inversion circuit must
+    match the classical S-box table (the claim docs/KERNEL_DESIGN.md makes)."""
+    import jax.numpy as jnp
+
+    vals = np.arange(256, dtype=np.uint32)
+    planes = []
+    for b in range(8):
+        bits = (vals >> b) & 1
+        words = np.zeros(8, dtype=np.uint32)
+        for i in range(256):
+            words[i // 32] |= np.uint32(bits[i]) << np.uint32(i % 32)
+        planes.append(jnp.asarray(words))
+    out = hmac_aes._bs_sbox(planes)
+    res = np.zeros(256, dtype=np.uint32)
+    for b in range(8):
+        w = np.asarray(out[b])
+        for i in range(256):
+            res[i] |= ((int(w[i // 32]) >> (i % 32)) & 1) << b
+    assert np.array_equal(res, np.asarray(hmac_aes._SBOX, dtype=np.uint32))
