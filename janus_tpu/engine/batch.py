@@ -23,6 +23,7 @@ variant (core/src/vdaf.rs:24) as batched HMAC-SHA256 + AES-128-CTR kernels
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
@@ -66,7 +67,12 @@ class _TurboXofOps:
 
 class _HmacXofOps:
     """Device XofHmacSha256Aes128: seed is the HMAC key; the message is
-    len(dst) || dst || binder (janus_tpu.ops.hmac_aes)."""
+    len(dst) || dst || binder (janus_tpu.ops.hmac_aes).
+
+    XofOps contract: the engine always calls with a RANK-1 batch shape
+    (N,).  _TurboXofOps happens to accept arbitrary batch ranks; the
+    bitsliced-CTR backend here enforces rank 1 (hmac_aes.expand_field64
+    packs keystream blocks along the single report axis)."""
 
     def __init__(self, field):
         from janus_tpu.ops import hmac_aes
@@ -177,6 +183,18 @@ class BatchPrio3:
         self._leader_fns: dict[int, object] = {}
         self._agg_fn = None
         self.fallback_count = 0  # reports recomputed on host (observability)
+        # Cumulative wall-time split of helper_init_batch, for the bench
+        # harness's host/device fraction report (VERDICT r2 #7).  "device"
+        # includes dispatch + the blocking transfer of the per-lane outputs.
+        # Guarded by a lock: concurrent job workers call the engine from
+        # multiple threads, and the fractions must at least be a consistent
+        # sum of per-call intervals (overlapping calls mean the total can
+        # exceed wall time; the RATIOS are what the bench publishes).
+        import threading
+
+        self._timings_lock = threading.Lock()
+        self.timings = {"decode": 0.0, "device": 0.0, "encode": 0.0,
+                        "batches": 0}
 
     def bind(self, agg_param: bytes) -> "BatchPrio3":
         """Prio3 takes no aggregation parameter; binding is a no-op."""
@@ -438,6 +456,7 @@ class BatchPrio3:
                 for i in range(N)
             ]
 
+        t_begin = time.monotonic()
         M = self._bucket(N)
         ss = self.vdaf.SEED_SIZE
         seeds = np.zeros((M, ss), dtype=np.uint8)
@@ -490,11 +509,9 @@ class BatchPrio3:
         fn = self._helper_fn(M)
         nonce_rows = np.zeros((M, 16), dtype=np.uint8)
         nonce_rows[:N] = nonces_arr(nonces)
-        import time as _t
-
         from janus_tpu.metrics import device_batch_reports, device_batch_seconds
 
-        t0 = _t.monotonic()
+        t0 = time.monotonic()
         # Only the small per-lane outputs come back to the host; the output
         # shares ([L, OUTPUT_LEN, M] — by far the largest tensor) and the
         # helper verifier stay on device.  Downstream aggregation reduces
@@ -507,7 +524,8 @@ class BatchPrio3:
         proof_ok = np.asarray(proof_ok_d)
         jr_ok = np.asarray(jr_ok_d)
         fallback = np.asarray(fallback_d)
-        device_batch_seconds.observe(_t.monotonic() - t0, kind="helper_init",
+        t_dev = time.monotonic()
+        device_batch_seconds.observe(t_dev - t0, kind="helper_init",
                                      bucket=M)
         device_batch_reports.add(N, kind="helper_init")
 
@@ -535,6 +553,13 @@ class BatchPrio3:
                 out_share_raw=LaneRef(out_share_d, i),
                 device_shares=out_share_d, lane=i,
             ))
+        t_end = time.monotonic()
+        with self._timings_lock:
+            tm = self.timings
+            tm["decode"] += t0 - t_begin
+            tm["device"] += t_dev - t0
+            tm["encode"] += t_end - t_dev
+            tm["batches"] += 1
         return out
 
     def leader_init_batch(
