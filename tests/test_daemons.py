@@ -133,3 +133,155 @@ def test_garbage_collector_deletes_expired_artifacts():
     counts = gc.run_once()
     assert counts["reports"] == 3
     assert counts["aggregation"] >= 1
+
+
+def _leader_helper_pair(measurements):
+    """A real in-process leader+helper pair with reports uploaded and one
+    aggregation job created; returns everything a driver test needs."""
+    from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+    from janus_tpu.client import Client, ClientParameters
+
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          VdafInstance.prio3_count())
+    builder.with_min_batch_size(1)
+    clock = MockClock(Time(1_700_000_000))
+    helper_ds, leader_ds = ephemeral_datastore(clock), ephemeral_datastore(clock)
+    helper_agg = Aggregator(helper_ds, clock,
+                            AggregatorConfig(batch_aggregation_shard_count=2))
+    leader_agg = Aggregator(leader_ds, clock,
+                            AggregatorConfig(batch_aggregation_shard_count=2))
+    hs = DapHttpServer(helper_agg).start()
+    ls = DapHttpServer(leader_agg).start()
+    builder.helper_endpoint = hs.address
+    builder.leader_endpoint = ls.address
+    helper_ds.run_tx("p", lambda tx: tx.put_aggregator_task(builder.helper_view()))
+    leader_ds.run_tx("p", lambda tx: tx.put_aggregator_task(builder.leader_view()))
+    client = Client(
+        ClientParameters(builder.task_id, ls.address, hs.address,
+                         builder.time_precision),
+        VdafInstance.prio3_count(), clock=clock)
+    for meas in measurements:
+        client.upload(meas)
+    leader_agg.report_writer.flush()
+    n = AggregationJobCreator(leader_ds, 1, 10,
+                              batch_aggregation_shard_count=2).run_once()
+    assert n == 1
+
+    def stop():
+        hs.stop()
+        ls.stop()
+
+    return builder, clock, leader_ds, stop
+
+
+class _FlakyPeer(PeerClient):
+    """Fails the first `n_failures` helper calls with a FINAL retryable
+    status (as if backoff was exhausted), then delegates to real HTTP."""
+
+    def __init__(self, n_failures):
+        super().__init__(backoff=Backoff(0.0001, 0.001, 2, 0.001))
+        self.n_failures = n_failures
+        self.calls = 0
+
+    def send_to_helper(self, task, method, path, body, content_type):
+        self.calls += 1
+        if self.calls <= self.n_failures:
+            from janus_tpu.aggregator.http_client import PeerHttpError
+
+            raise PeerHttpError(500, b"injected transient failure")
+        return super().send_to_helper(task, method, path, body, content_type)
+
+
+class _GarbagePeer(PeerClient):
+    """Returns 200 with an undecodable body (reference
+    aggregation_job_driver.rs:3983 fatal-response tests)."""
+
+    def send_to_helper(self, task, method, path, body, content_type):
+        return HttpResult(200, {}, b"\xff\xfenot a dap message")
+
+
+def test_driver_recovers_after_transient_peer_500():
+    """A retryable peer failure releases the lease; the next discovery round
+    (after lease expiry) re-steps the job to completion (reference
+    aggregation_job_driver.rs:3738 retryable-error tests)."""
+    builder, clock, leader_ds, stop = _leader_helper_pair([1, 0, 1])
+    try:
+        peer = _FlakyPeer(n_failures=1)
+        driver = AggregationJobDriver(leader_ds, peer_client=peer,
+                                      batch_aggregation_shard_count=2,
+                                      maximum_attempts_before_failure=5,
+                                      lease_duration_s=10)
+        from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+
+        jd = JobDriver(JobDriverConfig(), driver.acquirer, driver.stepper)
+        assert jd.run_once() == 1  # fails, lease released for retry
+        jobs = leader_ds.run_tx(
+            "j", lambda tx: tx.get_aggregation_jobs_for_task(builder.task_id))
+        assert jobs[0].state is m.AggregationJobState.IN_PROGRESS
+
+        assert jd.run_once() == 1  # released lease -> immediate re-acquire
+        jobs = leader_ds.run_tx(
+            "j", lambda tx: tx.get_aggregation_jobs_for_task(builder.task_id))
+        assert jobs[0].state is m.AggregationJobState.FINISHED
+        assert peer.calls == 2
+    finally:
+        stop()
+
+
+def test_driver_garbage_peer_response_abandons_after_max_attempts():
+    """An undecodable helper response is an error every attempt; the lease
+    expires each time and the job is abandoned at the attempt cap rather
+    than retrying forever."""
+    builder, clock, leader_ds, stop = _leader_helper_pair([1, 1])
+    try:
+        driver = AggregationJobDriver(leader_ds, peer_client=_GarbagePeer(),
+                                      batch_aggregation_shard_count=2,
+                                      maximum_attempts_before_failure=2,
+                                      lease_duration_s=10)
+        from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+
+        jd = JobDriver(JobDriverConfig(), driver.acquirer, driver.stepper)
+        for _ in range(4):
+            jd.run_once()
+            clock.advance(Duration(11))  # expire the lease for re-acquisition
+        jobs = leader_ds.run_tx(
+            "j", lambda tx: tx.get_aggregation_jobs_for_task(builder.task_id))
+        assert jobs[0].state is m.AggregationJobState.ABANDONED
+    finally:
+        stop()
+
+
+def test_lease_expiry_mid_step_loses_write_race_cleanly():
+    """A worker whose lease expired mid-step (and was re-acquired by another
+    worker) must NOT corrupt state: its release is a no-op because the lease
+    token no longer matches (reference datastore.rs:1828 token check)."""
+    builder, clock, leader_ds, stop = _leader_helper_pair([1])
+    try:
+        stale = leader_ds.run_tx(
+            "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(10), 1))[0]
+        clock.advance(Duration(11))  # stale's lease expires mid-step
+        fresh = leader_ds.run_tx(
+            "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 1))[0]
+        assert fresh.lease_attempts == 2
+
+        # the stale worker tries to release: token mismatch, loud no-op
+        from janus_tpu.datastore.datastore import MutationTargetNotFound
+
+        import pytest as _pytest
+
+        with _pytest.raises(MutationTargetNotFound):
+            leader_ds.run_tx(
+                "rel", lambda tx: tx.release_aggregation_job(stale))
+
+        # the fresh worker steps the job to completion normally
+        driver = AggregationJobDriver(leader_ds,
+                                      batch_aggregation_shard_count=2,
+                                      lease_duration_s=600)
+        driver.stepper(fresh)
+        jobs = leader_ds.run_tx(
+            "j", lambda tx: tx.get_aggregation_jobs_for_task(builder.task_id))
+        assert jobs[0].state is m.AggregationJobState.FINISHED
+    finally:
+        stop()

@@ -244,3 +244,119 @@ def test_helper_init_sumvec_device_path():
         assert total == [sum(col) for col in zip(*meas)]
     finally:
         server.stop()
+
+
+def test_helper_continue_step_skew_battery():
+    """Step-skew recovery over HTTP (reference
+    aggregation_job_continue.rs:597-816): same-step replay with an identical
+    body is re-served byte-for-byte; same-step with mutated content and step
+    gaps are StepMismatch; step 0 is invalid; unknown/non-waiting report ids
+    are invalid."""
+    from janus_tpu.messages import AggregationJobContinueReq, AggregationJobStep, PrepareContinue
+    from janus_tpu.vdaf.poplar1 import encode_agg_param
+
+    builder, task, clock, ds, agg, server = _helper_fixture(
+        VdafInstance.poplar1(4))
+    try:
+        sess = requests.Session()
+        base = f"{server.address}/tasks/{task.task_id}"
+        auth = builder.aggregator_auth_token.request_headers()
+        agg_param = encode_agg_param(1, [0b00, 0b10])
+        bound = _LeaderOracle(builder, clock).vdaf.with_agg_param(agg_param)
+
+        import os as _os
+
+        inits, states, report_ids = [], [], []
+        leader = _LeaderOracle(builder, clock)
+        for alpha in (0b1011, 0b0010, 0b1110):
+            report = leader.client.prepare_report(alpha, time=clock.now())
+            aad = InputShareAad(builder.task_id, report.metadata,
+                                report.public_share).encode()
+            plaintext = hpke.open_ciphertext(
+                builder.leader_hpke_keypair,
+                hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT,
+                                      Role.LEADER),
+                report.leader_encrypted_input_share, aad)
+            payload = PlaintextInputShare.decode(plaintext).payload
+            pub = bound.decode_public_share(report.public_share)
+            share = bound.decode_input_share(0, payload)
+            state, msg = ping_pong.leader_initialized(
+                bound, builder.verify_key, bytes(report.metadata.report_id),
+                pub, share)
+            rs = ReportShare(report.metadata, report.public_share,
+                             report.helper_encrypted_input_share)
+            inits.append(PrepareInit(rs, msg.encode()))
+            states.append(state)
+            report_ids.append(report.metadata.report_id)
+
+        job_id = AggregationJobId.random()
+        url = f"{base}/aggregation_jobs/{job_id}"
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=agg_param,
+            partial_batch_selector=PartialBatchSelector(
+                task.query_type.query_type),
+            prepare_inits=tuple(inits))
+        r = sess.put(url, data=req.encode(), headers=auth)
+        assert r.status_code == 200, r.content
+        resp = AggregationJobResp.decode(r.content)
+        assert all(pr.result.kind == PrepareStepResult.CONTINUE
+                   for pr in resp.prepare_resps)
+
+        # Leader's continue messages (round 2 of the Poplar1 sketch).
+        pcs = []
+        for pr, st, rid in zip(resp.prepare_resps, states, report_ids):
+            res = ping_pong.continued(
+                bound, st, ping_pong.PingPongMessage.decode(pr.result.message))
+            _fin, outbound = res.evaluate()
+            pcs.append(PrepareContinue(rid, outbound.encode()))
+
+        # step 0 is never a valid continue target
+        bad0 = AggregationJobContinueReq(AggregationJobStep(0), tuple(pcs))
+        r = sess.post(url, data=bad0.encode(), headers=auth)
+        assert r.status_code == 400
+        assert b"invalidMessage" in r.content
+
+        # step gap: helper is at step 0, a jump to step 2 is a mismatch
+        gap = AggregationJobContinueReq(AggregationJobStep(2), tuple(pcs))
+        r = sess.post(url, data=gap.encode(), headers=auth)
+        assert r.status_code == 400
+        assert b"stepMismatch" in r.content
+
+        # the real step-1 continue succeeds and finishes every report
+        cont = AggregationJobContinueReq(AggregationJobStep(1), tuple(pcs))
+        r = sess.post(url, data=cont.encode(), headers=auth)
+        assert r.status_code == 200, r.content
+        cont_resp_bytes = r.content
+        resp1 = AggregationJobResp.decode(cont_resp_bytes)
+        assert all(pr.result.kind == PrepareStepResult.FINISHED
+                   for pr in resp1.prepare_resps)
+
+        # same-step replay with IDENTICAL content: re-served byte-for-byte
+        r = sess.post(url, data=cont.encode(), headers=auth)
+        assert r.status_code == 200
+        assert r.content == cont_resp_bytes
+
+        # same-step replay with MUTATED content: hash differs -> StepMismatch
+        mutated = AggregationJobContinueReq(AggregationJobStep(1),
+                                            tuple(pcs[:2]))
+        r = sess.post(url, data=mutated.encode(), headers=auth)
+        assert r.status_code == 400
+        assert b"stepMismatch" in r.content
+
+        # advancing past the finished exchange is also a mismatch
+        nxt = AggregationJobContinueReq(AggregationJobStep(3), tuple(pcs))
+        r = sess.post(url, data=nxt.encode(), headers=auth)
+        assert r.status_code == 400
+        assert b"stepMismatch" in r.content
+
+        # a continue naming an unknown report id is invalid
+        from janus_tpu.messages import ReportId as _RID
+
+        unknown = AggregationJobContinueReq(
+            AggregationJobStep(2),
+            (PrepareContinue(_RID(_os.urandom(16)), pcs[0].message),))
+        r = sess.post(url, data=unknown.encode(), headers=auth)
+        assert r.status_code == 400
+        assert b"invalidMessage" in r.content
+    finally:
+        server.stop()
