@@ -45,8 +45,13 @@ def build_datastore(common, datastore_keys: list[str] | None) -> Datastore:
                          "(--datastore-keys or JANUS_DATASTORE_KEYS)")
     keys = [base64.urlsafe_b64decode(k + "=" * (-len(k) % 4)) for k in keys_b64]
     url = common.database.url
-    path = None if url in (":memory:", "") else url.removeprefix("sqlite://")
-    backend = SqliteBackend(path)
+    if url.startswith(("postgres://", "postgresql://")):
+        from janus_tpu.datastore.postgres import PostgresBackend
+
+        backend = PostgresBackend(url)
+    else:
+        path = None if url in (":memory:", "") else url.removeprefix("sqlite://")
+        backend = SqliteBackend(path)
     ds = Datastore(backend, Crypter(keys), RealClock(),
                    max_transaction_retries=common.max_transaction_retries)
     try:

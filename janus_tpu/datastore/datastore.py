@@ -160,8 +160,15 @@ class Datastore:
         # real concurrency from the database instead).
         self._tx_lock = threading.RLock()
 
+    def _connect_ddl(self):
+        """A connection whose statements go through DDL dialect translation
+        (no-op for sqlite; BYTEA/IDENTITY spellings for Postgres)."""
+        if getattr(self.backend, "dialect", "sqlite") == "postgres":
+            return self.backend.connect(ddl=True)
+        return self.backend.connect()
+
     def put_schema(self) -> None:
-        conn = self.backend.connect()
+        conn = self._connect_ddl()
         try:
             with conn:
                 for ddl in TABLES:
@@ -173,7 +180,7 @@ class Datastore:
 
     def migrate(self) -> None:
         """Upgrade an older on-disk schema to SCHEMA_VERSION in-place."""
-        conn = self.backend.connect()
+        conn = self._connect_ddl()
         try:
             row = conn.execute("SELECT MAX(version) FROM schema_version").fetchone()
             current = row[0] if row and row[0] is not None else 0
@@ -199,6 +206,8 @@ class Datastore:
     def run_tx(self, name: str, fn):
         """Run fn(tx) transactionally with serialization retry
         (reference datastore.rs:232)."""
+        if getattr(self.backend, "dialect", "sqlite") == "postgres":
+            return self._run_tx_pg(name, fn)
         last = None
         for _attempt in range(self.max_transaction_retries):
             with self._tx_lock:
@@ -227,6 +236,42 @@ class Datastore:
                     raise
                 finally:
                     conn.close()
+            if _attempt + 1 < self.max_transaction_retries:
+                _time.sleep(0.01)
+        raise last if last else DatastoreError("transaction retries exhausted")
+
+    def _run_tx_pg(self, name: str, fn):
+        """Postgres path: REPEATABLE READ with serialization-failure retry
+        and NO process-level lock — concurrency comes from the database,
+        exactly as in the reference (datastore.rs:232-283)."""
+        last = None
+        db_errors = self.backend.error_types()
+        for _attempt in range(self.max_transaction_retries):
+            conn = self.backend.acquire()
+            try:
+                self.backend.begin(conn)
+                tx = Transaction(self, conn, name)
+                result = fn(tx)
+                conn.commit()
+                return result
+            except SerializationConflict as e:
+                conn.rollback()
+                self.tx_retry_count += 1
+                _metric_tx_retry(name)
+                last = e
+            except db_errors as e:
+                conn.rollback()
+                if self.backend.is_serialization_failure(e):
+                    self.tx_retry_count += 1
+                    _metric_tx_retry(name)
+                    last = SerializationConflict(str(e))
+                else:
+                    raise DatastoreError(str(e)) from e
+            except Exception:
+                conn.rollback()
+                raise
+            finally:
+                self.backend.release(conn)
             if _attempt + 1 < self.max_transaction_retries:
                 _time.sleep(0.01)
         raise last if last else DatastoreError("transaction retries exhausted")
@@ -688,14 +733,16 @@ class Transaction:
         """Atomic lease claim (reference datastore.rs:1755)."""
         now = self._now()
         expiry = now + lease_duration.seconds
-        rows = self._exec(
-            """SELECT a.task_id, a.aggregation_job_id, t.query_type, t.vdaf
+        sql = """SELECT a.task_id, a.aggregation_job_id, t.query_type, t.vdaf
                FROM aggregation_jobs a JOIN tasks t ON a.task_id = t.task_id
                WHERE a.state = 'IN_PROGRESS' AND a.lease_expiry <= ?
                  AND (t.task_expiration IS NULL OR t.task_expiration >= ?)
-               ORDER BY a.lease_expiry LIMIT ?""",
-            (now, now, limit),
-        ).fetchall()
+               ORDER BY a.lease_expiry LIMIT ?"""
+        if getattr(self.ds.backend, "skip_locked", False):
+            # True queue-pop semantics (reference datastore.rs:1779): rows
+            # locked by a concurrent acquirer are skipped, not waited on.
+            sql += " FOR UPDATE OF a SKIP LOCKED"
+        rows = self._exec(sql, (now, now, limit)).fetchall()
         leases = []
         for tid, jid, qt_json, vdaf_json in rows:
             token = os.urandom(m.LeaseToken.SIZE)
@@ -1027,14 +1074,14 @@ class Transaction:
     ) -> list[m.Lease]:
         now = self._now()
         expiry = now + lease_duration.seconds
-        rows = self._exec(
-            """SELECT c.task_id, c.collection_job_id, t.query_type, t.vdaf,
+        sql = """SELECT c.task_id, c.collection_job_id, t.query_type, t.vdaf,
                       c.step_attempts
                FROM collection_jobs c JOIN tasks t ON c.task_id = t.task_id
                WHERE c.state = 'START' AND c.lease_expiry <= ?
-               ORDER BY c.lease_expiry LIMIT ?""",
-            (now, limit),
-        ).fetchall()
+               ORDER BY c.lease_expiry LIMIT ?"""
+        if getattr(self.ds.backend, "skip_locked", False):
+            sql += " FOR UPDATE OF c SKIP LOCKED"
+        rows = self._exec(sql, (now, limit)).fetchall()
         leases = []
         for tid, jid, qt_json, vdaf_json, step_attempts in rows:
             token = os.urandom(m.LeaseToken.SIZE)
