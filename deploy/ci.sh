@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# CI pipeline (reference .github/workflows/ci-build.yml): unit + integration
+# suite on the virtual CPU mesh, the composed-services end-to-end collect,
+# the multi-chip dryrun, and a smoke bench.  Exits non-zero on any failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+echo "== tests =="
+python -m pytest tests/ -x -q
+
+echo "== composed-services end-to-end =="
+python deploy/compose_e2e.py
+
+echo "== multi-chip dryrun (8-device virtual mesh) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== smoke bench =="
+BENCH_SMOKE=1 python bench.py
+
+echo "CI OK"

