@@ -71,11 +71,12 @@ def make_configs(smoke: bool):
         # configs[1]: Prio3Sum bits=32 (job size tuned to 49152)
         ("Prio3Sum32", lambda: prio3.new_sum(32), 1234,
          49_152 // s or 8, 49_152 // s or 8),
-        # configs[2] / north star: Prio3SumVec length=1000 (job size 16384:
-        # the unrolled-sponge + FLP program is stable there; 24576 trips a
-        # TPU-worker fault in the XLA runtime on v5e)
+        # configs[2] / north star: Prio3SumVec length=1000.  Job size 24576:
+        # the round-2 ">16384 trips a TPU-worker fault" no longer reproduces
+        # (swept to 32768 clean this round); 24576 balances the 26MB
+        # leader-verifier transfer against kernel compute for pipelining.
         ("Prio3SumVec1000", lambda: prio3.new_sum_vec(1000, 1, cl_sv),
-         [1] * 500 + [0] * 500, 16_384 // s or 8, 16_384 // s or 8),
+         [1] * 500 + [0] * 500, 49_152 // s or 8, 24_576 // s or 8),
         # configs[3]: Prio3Histogram length=256, ~100k reports, multi-job
         ("Prio3Histogram256", lambda: prio3.new_histogram(256, cl_h),
          7, 98_304 // s or 8, 49_152 // s or 8),
@@ -186,6 +187,12 @@ def main():
         try:
             vdaf = factory()
             engine = BatchPrio3(vdaf)
+            if batch <= 4096:
+                # small spec-pinned jobs: coalesce concurrent jobs into one
+                # launch, as the service plane does (engine/coalesce.py)
+                from janus_tpu.engine.coalesce import CoalescingEngine
+
+                engine = CoalescingEngine(engine, max_batch=16384)
             verify_key = bytes(range(vdaf.VERIFY_KEY_SIZE))
             n_base = 4 if vdaf.flp.MEAS_LEN > 100 else 16
             nonces, pubs, shares, inits = make_base_reports(
@@ -213,7 +220,7 @@ def main():
             split_serial = read_split()
             # multi-job concurrency (reference P2): overlap host work with
             # device compute; report the better configuration
-            workers = int(os.environ.get("BENCH_WORKERS", "6"))
+            workers = int(os.environ.get("BENCH_WORKERS", "10"))
             rps_mt, rps_mt_rounds, split_mt = 0.0, [], None
             if workers > 1:
                 fresh_split()

@@ -114,7 +114,7 @@ class LaneRef:
         return out.astype(dtype) if dtype is not None else out
 
 
-@dataclass
+@dataclass(slots=True)
 class PreparedReport:
     """Per-report outcome of a batched prepare step.
 
@@ -211,19 +211,19 @@ class BatchPrio3:
         """jit, sharding batch arguments/outputs over the report mesh when
         one is configured.
 
-        Wire-layout inputs are batch-leading (sharded on axis 0, after the
-        replicated verify key); `out_specs` gives each output's (axis, rank)
-        batch position — host-bound rows are batch-leading, device-resident
-        field tensors batch-minor."""
+        ALL inputs are batch-leading and sharded on axis 0 (the verify key
+        is a per-report column of the packed byte tensor, so nothing is
+        replicated); `out_specs` gives each output's (axis, rank) batch
+        position — host-bound rows are batch-leading, device-resident field
+        tensors batch-minor."""
         if self.mesh is None:
             return jax.jit(kernel)
-        from janus_tpu.parallel import replicated, report_sharding
+        from janus_tpu.parallel import report_sharding
 
-        rep = replicated(self.mesh)
         shard = report_sharding(self.mesh)
         return jax.jit(
             kernel,
-            in_shardings=(rep,) + (shard,) * n_sharded_args,
+            in_shardings=(shard,) * n_sharded_args,
             out_shardings=tuple(
                 report_sharding(self.mesh, axis=ax, rank=rk)
                 for ax, rk in out_specs
@@ -316,9 +316,10 @@ class BatchPrio3:
         else:
             state_seed = None
             jr = f.zeros((P, 0) + bs)
+        # vk arrives as PER-REPORT rows [N, key_size]: lanes from different
+        # tasks (different verify keys) can share one coalesced launch.
         qr_raw, rej = self.xops.expand(
-            bs, jnp.broadcast_to(vk, bs + (self.vdaf.VERIFY_KEY_SIZE,)),
-            self._dst(USAGE_QUERY_RANDOMNESS), [nonces],
+            bs, vk, self._dst(USAGE_QUERY_RANDOMNESS), [nonces],
             P * self.flp.QUERY_RAND_LEN,
         )
         reject = reject | rej
@@ -342,9 +343,20 @@ class BatchPrio3:
         P = self.P
         vlen = self.flp.VERIFIER_LEN
 
-        def kernel(vk, seeds, blinds, nonces, pub0, leader_jr_parts, leader_verifs_raw):
+        def kernel(packed, leader_verifs_raw):
+            # `packed` [N, ks + 4*ss + 16] u8: vk | seeds | blinds | nonces |
+            # pub0 | leader_jr_parts.  One bundled row per report = ONE
+            # host->device transfer for all byte inputs — per-transfer
+            # latency (tunnel RTT, PCIe doorbells) dominates small launches.
             bs = (N,)
             ss = self.vdaf.SEED_SIZE
+            ks = self.vdaf.VERIFY_KEY_SIZE
+            vk = packed[:, :ks]
+            seeds = packed[:, ks:ks + ss]
+            blinds = packed[:, ks + ss:ks + 2 * ss]
+            nonces = packed[:, ks + 2 * ss:ks + 2 * ss + 16]
+            pub0 = packed[:, ks + 2 * ss + 16:ks + 3 * ss + 16]
+            leader_jr_parts = packed[:, ks + 3 * ss + 16:ks + 4 * ss + 16]
             meas_raw, rej1 = self.xops.expand(
                 bs, seeds, self._dst(USAGE_MEAS_SHARE), [b"\x01"],
                 self.flp.MEAS_LEN,
@@ -386,10 +398,15 @@ class BatchPrio3:
             out_share = f.to_raw(self.bflp.truncate(meas))  # [L, OUT, N]
             # The 1-round helper sends only the finish seed on the wire, so
             # neither its verifier nor its joint-rand part leaves the device.
-            return (msg_seed, out_share, proof_ok, jr_ok, reject | bad_t)
+            # Host-bound outputs bundle into ONE u8 row per report
+            # (msg_seed | proof_ok | jr_ok | fallback): per-transfer latency
+            # dominates the downlink for small launches.
+            flags = jnp.stack([proof_ok, jr_ok, reject | bad_t],
+                              axis=-1).astype(jnp.uint8)
+            packed_out = jnp.concatenate([msg_seed, flags], axis=-1)
+            return (packed_out, out_share)
 
-        fn = self._jit(kernel, 6, out_specs=(
-            (0, 2), (2, 3), (0, 1), (0, 1), (0, 1)))
+        fn = self._jit(kernel, 2, out_specs=((0, 2), (2, 3)))
         self._helper_fns[N] = fn
         return fn
 
@@ -400,9 +417,16 @@ class BatchPrio3:
         P = self.P
         vlen = self.flp.VERIFIER_LEN
 
-        def kernel(vk, meas_rows, proofs_rows, blinds, nonces, pub1):
+        def kernel(packed, meas_rows, proofs_rows):
+            # `packed` [N, ks + ss + 16 + ss] u8: vk | blinds | nonces | pub1
+            # — one transfer for all byte inputs (see _helper_fn).
             bs = (N,)
             ss = self.vdaf.SEED_SIZE
+            ks = self.vdaf.VERIFY_KEY_SIZE
+            vk = packed[:, :ks]
+            blinds = packed[:, ks:ks + ss]
+            nonces = packed[:, ks + ss:ks + ss + 16]
+            pub1 = packed[:, ks + ss + 16:ks + 2 * ss + 16]
             # wire-layout inputs [N, n, L] -> kernel layout [L, n, N]
             meas_raw = jnp.transpose(meas_rows, (2, 1, 0))
             proofs_raw = jnp.transpose(proofs_rows, (2, 1, 0))
@@ -424,10 +448,15 @@ class BatchPrio3:
                 f.to_raw(verifier).reshape((self.L, P * vlen) + bs), (2, 1, 0))
             if state_seed is None:
                 state_seed = jnp.zeros(bs + (ss,), dtype=jnp.uint8)
-            return verif_raw, own_part, state_seed, out_share, reject | bad_t
+            # bundle the small host-bound outputs into one u8 tensor:
+            # own_part | state_seed | fallback flag
+            packed_out = jnp.concatenate(
+                [own_part, state_seed,
+                 (reject | bad_t)[:, None].astype(jnp.uint8)], axis=-1)
+            return verif_raw, packed_out, out_share
 
-        fn = self._jit(kernel, 5, out_specs=(
-            (0, 3), (0, 2), (0, 2), (2, 3), (0, 1)))
+        fn = self._jit(kernel, 3, out_specs=(
+            (0, 3), (0, 2), (2, 3)))
         self._leader_fns[N] = fn
         return fn
 
@@ -435,7 +464,7 @@ class BatchPrio3:
 
     def helper_init_batch(
         self,
-        verify_key: bytes,
+        verify_key: bytes | list[bytes],
         nonces: list[bytes],
         public_shares: list[bytes],
         input_shares: list[bytes],
@@ -443,15 +472,22 @@ class BatchPrio3:
     ) -> list[PreparedReport]:
         """Batched ping_pong.helper_initialized + transition.evaluate().
 
+        `verify_key` is one key for the whole batch, or one PER REPORT (a
+        coalesced launch mixing jobs from different tasks — SURVEY §2.7 P2).
         Returns one PreparedReport per input, in order: status "finished"
         with the outbound finish message and raw output share, or "failed"
         with the reason (bad proof / joint rand mismatch / decode error).
         """
         N = len(nonces)
         assert N == len(public_shares) == len(input_shares) == len(inbound_messages)
+        per_report_vk = not isinstance(verify_key, (bytes, bytearray))
+
+        def vk_for(i: int) -> bytes:
+            return verify_key[i] if per_report_vk else verify_key
+
         if not self.device_ok:
             return [
-                self._host_helper(verify_key, nonces[i], public_shares[i],
+                self._host_helper(vk_for(i), nonces[i], public_shares[i],
                                   input_shares[i], inbound_messages[i])
                 for i in range(N)
             ]
@@ -459,10 +495,16 @@ class BatchPrio3:
         t_begin = time.monotonic()
         M = self._bucket(N)
         ss = self.vdaf.SEED_SIZE
-        seeds = np.zeros((M, ss), dtype=np.uint8)
-        blinds = np.zeros((M, ss), dtype=np.uint8)
-        pub0 = np.zeros((M, ss), dtype=np.uint8)
-        ljr = np.zeros((M, ss), dtype=np.uint8)
+        ks = self.vdaf.VERIFY_KEY_SIZE
+        # single bundled byte tensor: vk | seeds | blinds | nonces | pub0 |
+        # leader_jr_parts (see _helper_fn) — one transfer instead of six
+        packed = np.zeros((M, ks + 4 * ss + 16), dtype=np.uint8)
+        vk = packed[:, :ks]
+        seeds = packed[:, ks:ks + ss]
+        blinds = packed[:, ks + ss:ks + 2 * ss]
+        nonce_rows = packed[:, ks + 2 * ss:ks + 2 * ss + 16]
+        pub0 = packed[:, ks + 2 * ss + 16:ks + 3 * ss + 16]
+        ljr = packed[:, ks + 3 * ss + 16:ks + 4 * ss + 16]
         lverif = np.zeros((M, self.P * self.flp.VERIFIER_LEN, self.L), dtype=np.uint32)
         decode_err: dict[int, str] = {}
 
@@ -505,9 +547,11 @@ class BatchPrio3:
                 if not in_range[k]:
                     decode_err[i] = "prep share element out of range"
 
-        vk = np.frombuffer(verify_key, dtype=np.uint8)
+        if per_report_vk:
+            vk[:N] = _bytes_rows(list(verify_key), ks)
+        else:
+            vk[:N] = np.frombuffer(verify_key, dtype=np.uint8)
         fn = self._helper_fn(M)
-        nonce_rows = np.zeros((M, 16), dtype=np.uint8)
         nonce_rows[:N] = nonces_arr(nonces)
         from janus_tpu.metrics import device_batch_reports, device_batch_seconds
 
@@ -518,38 +562,45 @@ class BatchPrio3:
         # out_share_d with a lane mask and transfers one [OUTPUT_LEN, L] sum
         # per batch (HBM-bandwidth discipline; the 1-round helper never
         # sends its verifier on the wire, only the finish seed).
-        (msg_seed_d, out_share_d, proof_ok_d, jr_ok_d,
-         fallback_d) = fn(vk, seeds, blinds, nonce_rows, pub0, ljr, lverif)
-        msg_seed = np.asarray(msg_seed_d)
-        proof_ok = np.asarray(proof_ok_d)
-        jr_ok = np.asarray(jr_ok_d)
-        fallback = np.asarray(fallback_d)
+        packed_out_d, out_share_d = fn(packed, lverif)
+        packed_out = np.asarray(packed_out_d)
+        msg_seed = packed_out[:, :ss]
+        proof_ok = packed_out[:, ss].astype(bool)
+        jr_ok = packed_out[:, ss + 1].astype(bool)
+        fallback = packed_out[:, ss + 2].astype(bool)
         t_dev = time.monotonic()
         device_batch_seconds.observe(t_dev - t0, kind="helper_init",
                                      bucket=M)
         device_batch_reports.add(N, kind="helper_init")
 
+        # Assembly: per-report Python is the GIL-bound bracket around the
+        # kernel, so keep it lean — one .tolist()/.tobytes() per array
+        # (numpy scalar indexing costs ~100x a list index in this loop).
+        proof_ok_l = proof_ok.tolist()
+        jr_ok_l = jr_ok.tolist()
+        fallback_l = fallback.tolist()
+        seed_blob = msg_seed.tobytes() if self.has_jr else b""
+        ss_row = msg_seed.shape[1] if self.has_jr else 0
+        FINISH = ping_pong.PingPongMessage.TYPE_FINISH
+        mk_msg = ping_pong.PingPongMessage
         out: list[PreparedReport] = []
         for i in range(N):
             if i in decode_err:
                 out.append(PreparedReport("failed", error=decode_err[i]))
                 continue
-            if fallback[i]:
+            if fallback_l[i]:
                 self.fallback_count += 1
-                out.append(self._host_helper(verify_key, nonces[i], public_shares[i],
+                out.append(self._host_helper(vk_for(i), nonces[i], public_shares[i],
                                              input_shares[i], inbound_messages[i]))
                 continue
-            if not (proof_ok[i] and jr_ok[i]):
-                reason = "proof verification failed" if not proof_ok[i] else (
+            if not (proof_ok_l[i] and jr_ok_l[i]):
+                reason = "proof verification failed" if not proof_ok_l[i] else (
                     "joint randomness check failed")
                 out.append(PreparedReport("failed", error=reason))
                 continue
-            prep_msg = bytes(msg_seed[i]) if self.has_jr else b""
-            outbound = ping_pong.PingPongMessage(
-                ping_pong.PingPongMessage.TYPE_FINISH, prep_msg=prep_msg
-            )
+            prep_msg = seed_blob[i * ss_row:(i + 1) * ss_row]
             out.append(PreparedReport(
-                "finished", outbound=outbound,
+                "finished", outbound=mk_msg(FINISH, prep_msg=prep_msg),
                 out_share_raw=LaneRef(out_share_d, i),
                 device_shares=out_share_d, lane=i,
             ))
@@ -564,29 +615,42 @@ class BatchPrio3:
 
     def leader_init_batch(
         self,
-        verify_key: bytes,
+        verify_key: bytes | list[bytes],
         nonces: list[bytes],
         public_shares: list[bytes],
         input_shares: list[bytes],
     ) -> list[PreparedReport]:
         """Batched ping_pong.leader_initialized.
 
-        Returns reports with status "continued": `state` holds the
-        PingPongContinued (with device-computed prep state), `outbound` the
-        initialize message carrying the leader's prep share.
+        `verify_key` is one key for the whole batch or one per report (a
+        coalesced launch mixing tasks).  Returns reports with status
+        "continued": `state` holds the PingPongContinued (with
+        device-computed prep state), `outbound` the initialize message
+        carrying the leader's prep share.
         """
         N = len(nonces)
+        per_report_vk = not isinstance(verify_key, (bytes, bytearray))
+
+        def vk_for(i: int) -> bytes:
+            return verify_key[i] if per_report_vk else verify_key
+
         if not self.device_ok:
             return [
-                self._host_leader(verify_key, nonces[i], public_shares[i], input_shares[i])
+                self._host_leader(vk_for(i), nonces[i], public_shares[i],
+                                  input_shares[i])
                 for i in range(N)
             ]
         M = self._bucket(N)
         ss = self.vdaf.SEED_SIZE
+        ks = self.vdaf.VERIFY_KEY_SIZE
         meas_raw = np.zeros((M, self.flp.MEAS_LEN, self.L), dtype=np.uint32)
         proofs_raw = np.zeros((M, self.P * self.flp.PROOF_LEN, self.L), dtype=np.uint32)
-        blinds = np.zeros((M, ss), dtype=np.uint8)
-        pub1 = np.zeros((M, ss), dtype=np.uint8)
+        # bundled byte tensor: vk | blinds | nonces | pub1 (see _leader_fn)
+        packed = np.zeros((M, ks + 2 * ss + 16), dtype=np.uint8)
+        vk = packed[:, :ks]
+        blinds = packed[:, ks:ks + ss]
+        nonce_rows = packed[:, ks + ss:ks + ss + 16]
+        pub1 = packed[:, ks + ss + 16:]
         decode_err: dict[int, str] = {}
 
         # Vectorized decode of the leader input share layout
@@ -624,18 +688,21 @@ class BatchPrio3:
                 if not in_range[k]:
                     decode_err[i] = "input share element out of range"
 
-        vk = np.frombuffer(verify_key, dtype=np.uint8)
+        if per_report_vk:
+            vk[:N] = _bytes_rows(list(verify_key), ks)
+        else:
+            vk[:N] = np.frombuffer(verify_key, dtype=np.uint8)
         fn = self._leader_fn(M)
-        nonce_rows = np.zeros((M, 16), dtype=np.uint8)
         nonce_rows[:N] = nonces_arr(nonces)
         # The leader's verifier IS wire payload (PrepareInit prep share), so
         # it must come to the host; output shares stay on device.
-        verif_raw_d, own_part_d, state_seed_d, out_share_d, fallback_d = fn(
-            vk, meas_raw, proofs_raw, blinds, nonce_rows, pub1)
+        verif_raw_d, packed_out_d, out_share_d = fn(
+            packed, meas_raw, proofs_raw)
         verif_raw = np.asarray(verif_raw_d)
-        own_part = np.asarray(own_part_d)
-        state_seed = np.asarray(state_seed_d)
-        fallback = np.asarray(fallback_d)
+        packed_out = np.asarray(packed_out_d)
+        own_part = packed_out[:, :ss]
+        state_seed = packed_out[:, ss:2 * ss]
+        fallback = packed_out[:, 2 * ss].astype(bool)
         out: list[PreparedReport] = []
         for i in range(N):
             if i in decode_err:
@@ -643,7 +710,7 @@ class BatchPrio3:
                 continue
             if fallback[i]:
                 self.fallback_count += 1
-                out.append(self._host_leader(verify_key, nonces[i], public_shares[i],
+                out.append(self._host_leader(vk_for(i), nonces[i], public_shares[i],
                                              input_shares[i]))
                 continue
             prep_share = (bytes(own_part[i]) if self.has_jr else b"") + (

@@ -1,0 +1,208 @@
+"""Cross-job device-launch coalescing (SURVEY §2.7 P2's TPU-native form).
+
+The common DAP workload is many SMALL aggregation jobs (the spec pins
+Prio3Count jobs at ~1k reports); launching one device program per job wastes
+the chip on dispatch/transfer latency.  This engine sits in front of
+BatchPrio3 and mirrors `ReportWriteBatcher`'s coalescing discipline
+(report_writer.py, reference P5): concurrent helper_init_batch /
+leader_init_batch calls enqueue their reports and a dispatcher thread packs
+everything waiting — across jobs AND across tasks, since the verify key is
+a per-report kernel input — into one device launch, then scatters the
+per-lane results back to each caller.
+
+Semantics are identical to calling the inner engine per job: every lane is
+independent (per-lane failure, never batch abort), and the inner engine
+already buckets/pads the combined batch.  Latency cost is bounded by
+`max_delay_ms`; a lone job under low load pays one delay window.
+
+Reference analog: the per-job concurrency semantics of
+binary_utils/job_driver.rs:203-249, which the reference can only overlap on
+CPU threads — here overlapping jobs become literally one kernel launch.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from janus_tpu.engine.batch import BatchPrio3, PreparedReport
+
+
+class _Pending:
+    __slots__ = ("kind", "verify_key", "args", "n", "event", "result", "error")
+
+    def __init__(self, kind: str, verify_key: bytes, args: tuple, n: int):
+        self.kind = kind
+        self.verify_key = verify_key
+        self.args = args  # tuple of per-report lists
+        self.n = n
+        self.event = threading.Event()
+        self.result: list[PreparedReport] | None = None
+        self.error: BaseException | None = None
+
+
+class CoalescingEngine:
+    """BatchPrio3 facade that packs concurrent job batches into one launch.
+
+    `max_batch` bounds the combined launch (larger jobs pass through
+    untouched); `max_delay_ms` is how long a lone job waits for company.
+    """
+
+    def __init__(self, inner: BatchPrio3, max_batch: int = 16384,
+                 max_delay_ms: float = 4.0, launch_depth: int = 4):
+        self.inner = inner
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1000.0
+        self._lock = threading.Lock()
+        self._queue: list[_Pending] = []
+        self._dispatcher: threading.Thread | None = None
+        # Launches run on a small pool so several can be in flight at once:
+        # per-launch latency (transfer RTTs + dispatch) would otherwise gate
+        # throughput at in_flight_reports / launch_latency.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._launch_pool = ThreadPoolExecutor(launch_depth)
+
+    # -- facade ------------------------------------------------------------
+
+    @property
+    def vdaf(self):
+        return self.inner.vdaf
+
+    @property
+    def device_ok(self):
+        return self.inner.device_ok
+
+    @property
+    def fallback_count(self):
+        return self.inner.fallback_count
+
+    @property
+    def timings(self):
+        return self.inner.timings
+
+    @timings.setter
+    def timings(self, value):
+        self.inner.timings = value
+
+    def bind(self, agg_param: bytes):
+        self.inner.bind(agg_param)  # raises on a bad param
+        return self
+
+    def __getattr__(self, name):
+        # anything not coalescing-specific (host fallbacks, field/flp
+        # introspection) passes through to the inner engine
+        return getattr(self.inner, name)
+
+    def aggregate(self, reports):
+        return self.inner.aggregate(reports)
+
+    def aggregate_raw_rows(self, rows):
+        return self.inner.aggregate_raw_rows(rows)
+
+    def aggregate_masked(self, shares, mask):
+        return self.inner.aggregate_masked(shares, mask)
+
+    def leader_finish(self, reports, inbound_messages):
+        return self.inner.leader_finish(reports, inbound_messages)
+
+    # -- coalesced entry points -------------------------------------------
+
+    def helper_init_batch(self, verify_key, nonces, public_shares,
+                          input_shares, inbound_messages):
+        return self._submit("helper", verify_key,
+                            (nonces, public_shares, input_shares,
+                             inbound_messages))
+
+    def leader_init_batch(self, verify_key, nonces, public_shares,
+                          input_shares):
+        return self._submit("leader", verify_key,
+                            (nonces, public_shares, input_shares))
+
+    # -- machinery ---------------------------------------------------------
+
+    def _submit(self, kind: str, verify_key, args) -> list[PreparedReport]:
+        n = len(args[0])
+        if n == 0:
+            return []
+        if n >= self.max_batch or not self.inner.device_ok:
+            # big enough to own a launch (or host path): no coalescing
+            fn = (self.inner.helper_init_batch if kind == "helper"
+                  else self.inner.leader_init_batch)
+            return fn(verify_key, *args)
+        p = _Pending(kind, verify_key, args, n)
+        with self._lock:
+            self._queue.append(p)
+            if self._dispatcher is None:
+                self._dispatcher = threading.Thread(
+                    target=self._dispatch_loop, daemon=True)
+                self._dispatcher.start()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _dispatch_loop(self) -> None:
+        import time
+
+        batch: list[_Pending] = []
+        try:
+            while True:
+                time.sleep(self.max_delay)  # collection window
+                with self._lock:
+                    if not self._queue:
+                        self._dispatcher = None
+                        return
+                    batch, self._queue = self._queue, []
+                # split by kind; pack each kind into launches of <=
+                # max_batch, submitted concurrently (bounded by the pool)
+                for kind in ("helper", "leader"):
+                    group = [p for p in batch if p.kind == kind]
+                    chunk: list[_Pending] = []
+                    total = 0
+                    for p in group:
+                        if chunk and total + p.n > self.max_batch:
+                            self._launch_pool.submit(self._run_group, kind,
+                                                     chunk)
+                            chunk, total = [], 0
+                        chunk.append(p)
+                        total += p.n
+                    if chunk:
+                        self._launch_pool.submit(self._run_group, kind, chunk)
+                batch = []
+        except BaseException as e:
+            # The dispatcher must NEVER die silently: fail everything that
+            # could be waiting on it (drained + still-queued) and clear the
+            # thread slot so the next submit starts a fresh dispatcher.
+            with self._lock:
+                pending, self._queue = self._queue, []
+                self._dispatcher = None
+            for p in batch + pending:
+                if not p.event.is_set():
+                    p.error = e
+                    p.event.set()
+            raise
+
+    def _run_group(self, kind: str, group: list[_Pending]) -> None:
+        try:
+            n_args = len(group[0].args)
+            merged = [[] for _ in range(n_args)]
+            vks: list[bytes] = []
+            for p in group:
+                for j in range(n_args):
+                    merged[j].extend(p.args[j])
+                vks.extend([p.verify_key] * p.n)
+            fn = (self.inner.helper_init_batch if kind == "helper"
+                  else self.inner.leader_init_batch)
+            results = fn(vks, *merged)
+            off = 0
+            for p in group:
+                p.result = results[off:off + p.n]
+                off += p.n
+                p.event.set()
+        except BaseException as e:  # deliver the failure to every waiter
+            for p in group:
+                if not p.event.is_set():
+                    p.error = e
+                    p.event.set()
+
+

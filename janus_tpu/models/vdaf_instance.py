@@ -193,11 +193,17 @@ def prep_engine(inst: VdafInstance):
             vdaf = vdaf_for_instance(inst)
             if isinstance(vdaf, _prio3.Prio3):
                 from janus_tpu.engine import BatchPrio3
+                from janus_tpu.engine.coalesce import CoalescingEngine
 
-                engine = BatchPrio3(vdaf)
+                # Coalesce concurrent small jobs into one device launch
+                # (SURVEY §2.7 P2); _engines caches one engine per
+                # VdafInstance, so every task with these VDAF parameters
+                # shares the launch queue (the verify key is a per-report
+                # kernel input, so mixed-task launches are safe).
+                engine = CoalescingEngine(BatchPrio3(vdaf))
             elif inst.kind == "Poplar1":
-                # batched IDPF walk + sketch on device (inner levels;
-                # the Field255 leaf level falls back to the host oracle)
+                # batched IDPF walk + sketch on device, every level: Field64
+                # inner walk/sketch and the Field255 leaf (ops/field255.py)
                 from janus_tpu.engine.batch_poplar1 import BatchPoplar1
 
                 engine = BatchPoplar1(vdaf)
