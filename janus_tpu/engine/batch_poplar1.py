@@ -70,9 +70,10 @@ class BatchPoplar1(HostPrepEngine):
     def _device_eligible(self) -> bool:
         if self.vdaf._agg_param is None:
             return False
-        level, prefixes = self.vdaf._agg_param
-        # leaf level carries Field255 payloads: host path
-        return level < self.vdaf.bits - 1 and len(prefixes) > 0
+        _level, prefixes = self.vdaf._agg_param
+        # all levels run on device: Field64 inner walk + sketch, Field255
+        # leaf (ops/field255.py + eval_leaf_level) since round 3
+        return len(prefixes) > 0
 
     def _precompute(self, verify_key: bytes, agg_id: int, nonces, decoded):
         """Device batch over all decodable reports.
@@ -83,11 +84,18 @@ class BatchPoplar1(HostPrepEngine):
         import jax.numpy as jnp
 
         from janus_tpu.ops import field64 as f64
+        from janus_tpu.ops import field255 as f255
         from janus_tpu.ops import xof_batch
-        from janus_tpu.ops.idpf_batch import eval_inner_level, pack_prefix_bits
+        from janus_tpu.ops.idpf_batch import (
+            eval_inner_level,
+            eval_leaf_level,
+            pack_prefix_bits,
+        )
 
         level, prefixes = self.vdaf._bound()
         P = len(prefixes)
+        leaf = level == self.vdaf.bits - 1
+        L = 8 if leaf else 2  # u32 limbs per element (Field255 / Field64)
         idx = [i for i, d in enumerate(decoded) if d is not None]
         if not idx:
             return [None] * len(decoded)
@@ -97,13 +105,16 @@ class BatchPoplar1(HostPrepEngine):
         N = bucket_size(len(idx))
         n_levels = level + 1
 
+        def to_limbs(v: int) -> list[int]:
+            return [(v >> (32 * j)) & 0xFFFFFFFF for j in range(L)]
+
         fixed = np.zeros((N, 16), dtype=np.uint8)
         seeds = np.zeros((N, 16), dtype=np.uint8)
         cw_seeds = np.zeros((n_levels, N, 16), dtype=np.uint8)
         cw_ctrls = np.zeros((n_levels, N, 2), dtype=np.uint8)
-        payload = np.zeros((2, N), dtype=np.uint32)
+        payload = np.zeros((L, N), dtype=np.uint32)
         corr_seeds = np.zeros((N, 16), dtype=np.uint8)
-        offs = np.zeros((2, 3, N), dtype=np.uint32)
+        offs = np.zeros((L, 3, N), dtype=np.uint32)
         nonce_rows = np.zeros((N, 16), dtype=np.uint8)
         for k, i in enumerate(idx):
             key, corr_seed, offsets = decoded[i]
@@ -116,14 +127,11 @@ class BatchPoplar1(HostPrepEngine):
                 cs, cl, cr = key.seed_cws[lv]
                 cw_seeds[lv, k] = np.frombuffer(cs, dtype=np.uint8)
                 cw_ctrls[lv, k] = (cl, cr)
-            pcw = key.payload_cws[level][0]
-            payload[0, k] = pcw & 0xFFFFFFFF
-            payload[1, k] = pcw >> 32
+            payload[:, k] = to_limbs(key.payload_cws[level][0])
             corr_seeds[k] = np.frombuffer(corr_seed, dtype=np.uint8)
             if offsets is not None:
                 for j, v in enumerate(offsets[level]):
-                    offs[0, j, k] = v & 0xFFFFFFFF
-                    offs[1, j, k] = v >> 32
+                    offs[:, j, k] = to_limbs(v)
         prefix_bits = pack_prefix_bits(prefixes, level, n_levels)
         party = agg_id == 1
 
@@ -137,26 +145,35 @@ class BatchPoplar1(HostPrepEngine):
 
             binder_static = (level.to_bytes(2, "big")
                             + P.to_bytes(4, "big"))
+            fops = f255 if leaf else f64
+            expand = (xof_batch.expand_field255 if leaf
+                      else xof_batch.expand_field64)
 
             def kernel(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
                        corr_seeds, offs, nonce_rows, pb):
                 parties = jnp.full((N,), party, dtype=bool)
-                ys = eval_inner_level(fixed, seeds, parties, cw_seeds,
-                                      cw_ctrls, payload, pb, level, P)
-                rs, rej1 = xof_batch.expand_field64(
+                if leaf:
+                    ys, rej0 = eval_leaf_level(
+                        fixed, seeds, parties, cw_seeds, cw_ctrls, payload,
+                        pb, level, P)
+                else:
+                    ys = eval_inner_level(fixed, seeds, parties, cw_seeds,
+                                          cw_ctrls, payload, pb, level, P)
+                    rej0 = jnp.zeros((N,), dtype=bool)
+                rs, rej1 = expand(
                     (N,), [xof_batch.xof_prefix(b"poplar1 query"), vk_rows,
                            nonce_rows, binder_static], P)
-                corr, rej2 = xof_batch.expand_field64(
+                corr, rej2 = expand(
                     (N,), [xof_batch.xof_prefix(b"poplar1 corr"), corr_seeds,
                            level.to_bytes(2, "big")], 3)
-                abc = f64.add(corr, offs)  # [2, 3, N]
+                abc = fops.add(corr, offs)  # [L, 3, N]
                 a_s, c_s = abc[:, 0], abc[:, 2]
-                z = f64.sum_mod(f64.mul(rs, ys), axis=-2)
-                zs = f64.sum_mod(f64.mul(f64.mul(rs, rs), ys), axis=-2)
-                zc = f64.sum_mod(ys, axis=-2)
+                z = fops.sum_mod(fops.mul(rs, ys), axis=-2)
+                zs = fops.sum_mod(fops.mul(fops.mul(rs, rs), ys), axis=-2)
+                zc = fops.sum_mod(ys, axis=-2)
                 r1 = jnp.stack(
-                    [f64.add(z, a_s), f64.add(zs, c_s), zc], axis=1)
-                return ys, abc, r1, rej1 | rej2
+                    [fops.add(z, a_s), fops.add(zs, c_s), zc], axis=1)
+                return ys, abc, r1, rej0 | rej1 | rej2
 
             fn = jax.jit(kernel)
             self._fns[fn_key] = fn
@@ -167,23 +184,33 @@ class BatchPoplar1(HostPrepEngine):
         ys_d, abc_d, r1_d, rej_d = fn(vk_rows, fixed, seeds, cw_seeds,
                                       cw_ctrls, payload, corr_seeds, offs,
                                       nonce_rows, prefix_bits)
-        ys = np.asarray(ys_d)
-        abc = np.asarray(abc_d)
-        r1 = np.asarray(r1_d)
         rej = np.asarray(rej_d)
-        ys64 = ys[0].astype(np.uint64) | (ys[1].astype(np.uint64) << 32)
-        abc64 = abc[0].astype(np.uint64) | (abc[1].astype(np.uint64) << 32)
-        r164 = r1[0].astype(np.uint64) | (r1[1].astype(np.uint64) << 32)
+
+        def to_ints(arr_d) -> np.ndarray:
+            """Vectorized limb fold: [L, ...] u32 -> object array of ints
+            (one whole-array pass, not per-scalar indexing in the loop)."""
+            arr = np.asarray(arr_d)
+            if L == 2:
+                return (arr[0].astype(np.uint64)
+                        | (arr[1].astype(np.uint64) << 32)).astype(object)
+            acc = np.zeros(arr.shape[1:], dtype=object)
+            for j in range(L):
+                acc += arr[j].astype(object) << (32 * j)
+            return acc
+
+        ys_i = to_ints(ys_d)    # [P, N]
+        abc_i = to_ints(abc_d)  # [3, N]
+        r1_i = to_ints(r1_d)    # [3, N]
 
         out: list = [None] * len(decoded)
         for k, i in enumerate(idx):
             if rej[k]:
                 self.fallback_count += 1
                 continue  # host fallback (XOF rejection lane)
-            state = PrepState([int(v) for v in ys64[:, k]], None)
-            state.poplar = (agg_id, level, int(abc64[0, k]),
-                            int(abc64[1, k]), int(abc64[2, k]))
-            share = PrepShare(None, [int(v) for v in r164[:, k]])
+            state = PrepState([int(v) for v in ys_i[:, k]], None)
+            state.poplar = (agg_id, level, int(abc_i[0, k]),
+                            int(abc_i[1, k]), int(abc_i[2, k]))
+            share = PrepShare(None, [int(v) for v in r1_i[:, k]])
             out[i] = (state, share)
         return out
 

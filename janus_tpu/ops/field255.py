@@ -1,0 +1,268 @@
+"""Field255 (p = 2^255 - 19) as vectorized uint32-limb JAX ops.
+
+The IDPF leaf field of Poplar1 (reference: prio's poplar1 leaf level,
+consumed via core/src/vdaf.rs:94; SURVEY.md §2.8).  Until this module the
+leaf level — the most expensive Poplar1 prepare step — ran on the host
+oracle (round-2 known gap).
+
+Design (TPU VPU, like janus_tpu.ops.field64/field128):
+- An element of logical shape S is a uint32 array of shape (8,) + S, limb 0
+  least significant, STANDARD form, canonical (< p).  The limb axis leads
+  and the batch axis is minor, so (8, 128) register tiles fill with the
+  report/prefix axis.
+- p is pseudo-Mersenne: 2^255 ≡ 19, so 2^256 ≡ 38 (mod p).  `mul` is
+  schoolbook 8x8 32-bit limbs into a 16-limb product, then two 38-folds of
+  the high half and canonicalization — no Montgomery form needed (unlike
+  Field128, whose modulus has no cheap raw reduction).
+- No data-dependent branches; every op is elementwise over the batch.
+
+Tested bit-for-bit against the host oracle (janus_tpu.vdaf.idpf.Field255)
+in tests/test_field255.py, including exhaustive carry-edge vectors around
+p, 2^255, and limb boundaries.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+MODULUS = (1 << 255) - 19
+LIMBS = 8
+
+_U32 = jnp.uint32
+_MASK16 = jnp.uint32(0xFFFF)
+
+_P_LIMBS_INT = tuple((MODULUS >> (32 * i)) & 0xFFFFFFFF for i in range(8))
+
+
+def _limbs(value: int) -> np.ndarray:
+    return np.array([(value >> (32 * i)) & 0xFFFFFFFF for i in range(8)],
+                    dtype=np.uint32)
+
+
+_P = _limbs(MODULUS)
+
+
+# ---------------------------------------------------------------------------
+# host packing helpers
+# ---------------------------------------------------------------------------
+
+
+def pack(values) -> np.ndarray:
+    """Python ints -> uint32 limb array ((8,) + shape), canonical."""
+    vals = np.array(values, dtype=object)
+    flat = [int(v) % MODULUS for v in np.ravel(vals)]
+    arr = np.asarray(
+        [[(v >> (32 * i)) & 0xFFFFFFFF for v in flat] for i in range(8)],
+        dtype=np.uint32,
+    )
+    return arr.reshape((8,) + np.shape(vals))
+
+
+def unpack(x) -> np.ndarray:
+    """uint32 limb array -> numpy object array of Python ints."""
+    x = np.asarray(x)
+    acc = np.zeros(x.shape[1:], dtype=object)
+    for i in range(8):
+        acc = acc + (x[i].astype(object) << (32 * i))
+    return acc
+
+
+def zeros(shape) -> jnp.ndarray:
+    return jnp.zeros((8,) + tuple(shape), dtype=_U32)
+
+
+# ---------------------------------------------------------------------------
+# limb primitives
+# ---------------------------------------------------------------------------
+
+
+def _mul32(a, b):
+    """Full 32x32 -> 64-bit product as (lo, hi) uint32 via 16-bit partials."""
+    a0 = a & _MASK16
+    a1 = a >> 16
+    b0 = b & _MASK16
+    b1 = b >> 16
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    mid = lh + hl
+    mid_carry = (mid < lh).astype(_U32)
+    lo = ll + ((mid & _MASK16) << 16)
+    lo_carry = (lo < ll).astype(_U32)
+    hi = hh + (mid >> 16) + (mid_carry << 16) + lo_carry
+    return lo, hi
+
+
+def _addv(x, y, n=8):
+    """n-limb add of two [n, ...] arrays -> (limb list, carry_out)."""
+    out = []
+    carry = jnp.zeros(jnp.broadcast_shapes(x[0].shape, y[0].shape), dtype=_U32)
+    for i in range(n):
+        s = x[i] + y[i]
+        c1 = (s < x[i]).astype(_U32)
+        s2 = s + carry
+        c2 = (s2 < carry).astype(_U32)
+        out.append(s2)
+        carry = c1 | c2
+    return out, carry
+
+
+def _subv(x, y, n=8):
+    out = []
+    borrow = jnp.zeros(jnp.broadcast_shapes(x[0].shape, y[0].shape), dtype=_U32)
+    for i in range(n):
+        d = x[i] - y[i]
+        b1 = (x[i] < y[i]).astype(_U32)
+        d2 = d - borrow
+        b2 = (d < borrow).astype(_U32)
+        out.append(d2)
+        borrow = b1 | b2
+    return out, borrow
+
+
+def _geq_p(limbs):
+    gt = jnp.zeros(limbs[0].shape, dtype=bool)
+    eq_ = jnp.ones(limbs[0].shape, dtype=bool)
+    for i in range(7, -1, -1):
+        c = jnp.asarray(np.uint32(_P_LIMBS_INT[i]))
+        gt = gt | (eq_ & (limbs[i] > c))
+        eq_ = eq_ & (limbs[i] == c)
+    return gt | eq_
+
+
+def _p_list(ndim: int):
+    p = jnp.asarray(_P).reshape((8,) + (1,) * ndim)
+    return [p[i] for i in range(8)]
+
+
+def _cond_sub_p(limbs, force=None):
+    """x - p where x >= p (or force); returns stacked (8, ...) array."""
+    need = _geq_p(limbs)
+    if force is not None:
+        need = need | force
+    sub_, _ = _subv(limbs, _p_list(limbs[0].ndim))
+    x = jnp.stack(limbs, axis=0)
+    return jnp.where(need, jnp.stack(sub_, axis=0), x)
+
+
+# ---------------------------------------------------------------------------
+# field ops (standard form, canonical in / canonical out)
+# ---------------------------------------------------------------------------
+
+
+def add(x, y):
+    s, carry = _addv([x[i] for i in range(8)], [y[i] for i in range(8)])
+    # x + y < 2p < 2^256; if the 2^256 carry is set the value is >= 2^256
+    # > p, handled by forcing the subtract (s - p then wraps correctly
+    # because s + 2^256 - p fits in 8 limbs: 2p - p = p < 2^256).
+    return _cond_sub_p(s, force=carry.astype(bool))
+
+
+def sub(x, y):
+    d, borrow = _subv([x[i] for i in range(8)], [y[i] for i in range(8)])
+    addp, _ = _addv(d, _p_list(d[0].ndim))
+    ds = jnp.stack(d, axis=0)
+    return jnp.where(borrow.astype(bool), jnp.stack(addp, axis=0), ds)
+
+
+def neg(x):
+    return sub(zeros(x.shape[1:]), x)
+
+
+def _fold38(hi_limbs, lo_limbs, n_hi):
+    """lo + 38 * hi (hi has n_hi limbs) -> limb list (9 entries max used)."""
+    batch = lo_limbs[0].shape
+    zero = jnp.zeros(batch, dtype=_U32)
+    out = list(lo_limbs) + [zero]
+    c38 = _U32(38)
+    carry = zero
+    for i in range(n_hi):
+        lo, hi = _mul32(hi_limbs[i], c38)
+        s = out[i] + lo
+        c1 = (s < lo).astype(_U32)
+        s2 = s + carry
+        c2 = (s2 < carry).astype(_U32)
+        out[i] = s2
+        carry = hi + c1 + c2  # hi <= 2^32-2, safe
+    # propagate the tail carry
+    for i in range(n_hi, 9):
+        s = out[i] + carry
+        carry = (s < carry).astype(_U32)
+        out[i] = s
+    return out
+
+
+def mul(x, y):
+    """Schoolbook multiply + double 38-fold (2^256 ≡ 38 mod p)."""
+    batch = jnp.broadcast_shapes(x.shape[1:], y.shape[1:])
+    zero = jnp.zeros(batch, dtype=_U32)
+    t = [zero] * 16
+    for i in range(8):
+        xi = x[i]
+        carry = zero
+        for j in range(8):
+            lo, hi = _mul32(xi, y[j])
+            s = t[i + j] + lo
+            c1 = (s < lo).astype(_U32)
+            s2 = s + carry
+            c2 = (s2 < carry).astype(_U32)
+            t[i + j] = s2
+            carry = hi + c1 + c2
+        # tail: add the final carry into t[i+8..]; it can ripple
+        k = i + 8
+        while k < 16:
+            s = t[k] + carry
+            carry = (s < carry).astype(_U32)
+            t[k] = s
+            k = k + 1
+            # ripple stops when carry is 0; the loop is static (bounded)
+    # fold 1: v = t[0..8) + 38 * t[8..16)  (9 limbs, < 2^262)
+    v = _fold38(t[8:16], t[0:8], 8)
+    # fold 2: w = v[0..8) + 38 * v[8]  (v[8] < 2^6 -> 38*v[8] < 2^12)
+    w = _fold38([v[8]], v[0:8], 1)
+    # w[8] is 0 or 1 (w < 2^256 + tiny); fold the 2^256 bit once more
+    w2 = _fold38([w[8]], w[0:8], 1)
+    # now w2 < 2^256, w2[8] == 0; canonicalize with up to two subtracts
+    # (w2 < 2^256 < 2p + 2p, two conditional subtracts suffice since
+    #  2^256 - 2p = 38 - ... actually 2^256 = 2p + 38, so w2 < 2p + 38:
+    #  at most two subtracts of p)
+    r = _cond_sub_p(w2[0:8])
+    r_l = [r[i] for i in range(8)]
+    return _cond_sub_p(r_l)
+
+
+def mul_const(x, c: int):
+    return mul(x, jnp.asarray(_limbs(c % MODULUS)).reshape(
+        (8,) + (1,) * (x.ndim - 1)))
+
+
+def sum_mod(x, axis: int):
+    """Modular sum along `axis` of the LOGICAL shape (the leading limb axis
+    is not counted: axis=0 is the first axis after the limbs; negative
+    axes count from the minor end as usual)."""
+    ax = axis + 1 if axis >= 0 else x.ndim + axis
+    n = x.shape[ax]
+    # pairwise tree: log2(n) adds, each canonical
+    arrs = [jnp.take(x, i, axis=ax) for i in range(n)]
+    while len(arrs) > 1:
+        nxt = []
+        for i in range(0, len(arrs) - 1, 2):
+            nxt.append(add(arrs[i], arrs[i + 1]))
+        if len(arrs) % 2:
+            nxt.append(arrs[-1])
+        arrs = nxt
+    return arrs[0] if arrs else zeros(
+        x.shape[1:ax] + x.shape[ax + 1:])
+
+
+def select(cond, a, b):
+    """Elementwise select over the logical shape (cond broadcasts under the
+    limb axis)."""
+    return jnp.where(cond[None], a, b)
+
+
+def geq_p(x):
+    """x >= p elementwise (for rejection flags on raw candidates)."""
+    return _geq_p([x[i] for i in range(8)])

@@ -89,22 +89,13 @@ def pack_prefix_bits(prefixes, level: int, n_levels: int) -> np.ndarray:
         axis=2, dtype=np.uint32)
 
 
-def eval_inner_level(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
-                     payload_cws, prefix_bits, level: int, num_prefixes: int):
-    """Evaluate every (report, prefix) pair at an inner (Field64) level.
+def _walk(fixed_keys, seeds, parties, cw_seeds, cw_ctrls, prefix_bits,
+          level: int):
+    """Shared (report x prefix) tree walk to `level`.
 
-    fixed_keys: u8 [N, 16] per-report fixed AES keys
-    seeds:      u8 [N, 16] per-report root key seeds
-    parties:    bool [N] (True = party 1 negates its outputs)
-    cw_seeds:   u8 [n_levels, N, 16] per-level seed correction words
-    cw_ctrls:   u8 [n_levels, N, 2] (ctrl_l, ctrl_r) correction bits
-    payload_cws: u32 [2, N] Field64 limb pair of the level's payload cw
-                 (value_len = 1, Poplar1's shape)
-    prefix_bits: u32 [n_levels, B] packed per-level prefix selection words
-    level:      target level; n_levels = level + 1 walk steps
-    -> ys raw limbs [2, P, N] (P = num_prefixes), bit-exact with
-       Idpf.eval(...) per lane.
-    """
+    Returns (nxt, ctrl, rkp): the corrected pre-convert child seeds at the
+    target level (8 planes), the final control words [N, B], and the
+    per-report round-key planes for the convert blocks."""
     N = seeds.shape[0]
     n_levels = level + 1
     B = prefix_bits.shape[1]
@@ -125,6 +116,7 @@ def eval_inner_level(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
     cwl = _full_words(jnp.asarray(cw_ctrls)[..., 0])  # [n_levels, N]
     cwr = _full_words(jnp.asarray(cw_ctrls)[..., 1])
 
+    nxt = state
     for lv in range(n_levels):
         pb = jnp.asarray(prefix_bits[lv])[None, :]  # [1, B] packed prefix bit
         s_l = _prg_block_planes(state, rkp, LABEL_EXTEND, lv, 0)
@@ -146,16 +138,37 @@ def eval_inner_level(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
         if lv < level:
             state = _prg_block_planes(nxt, rkp, LABEL_CONVERT, lv, 0)
         ctrl = t
-        if lv == level:
-            # value block: candidate = first 8 bytes of block j=1 of the
-            # CONVERT stream keyed by the PRE-convert seed `nxt`
-            vb = _prg_block_planes(nxt, rkp, LABEL_CONVERT, lv, 1)
-            words = _planes_to_words(vb)  # [4, N, 32B] LE words
-            lo = words[0]  # [N, 32B]
-            hi = words[1] & _U32(0x7FFFFFFF)  # oracle clears the chunk's top bit
-            ys = jnp.stack([jnp.transpose(lo, (1, 0)),
-                            jnp.transpose(hi, (1, 0))], axis=0)  # [2, 32B, N]
-            ys = ys[:, :num_prefixes]
+    return nxt, ctrl, rkp
+
+
+def eval_inner_level(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
+                     payload_cws, prefix_bits, level: int, num_prefixes: int):
+    """Evaluate every (report, prefix) pair at an inner (Field64) level.
+
+    fixed_keys: u8 [N, 16] per-report fixed AES keys
+    seeds:      u8 [N, 16] per-report root key seeds
+    parties:    bool [N] (True = party 1 negates its outputs)
+    cw_seeds:   u8 [n_levels, N, 16] per-level seed correction words
+    cw_ctrls:   u8 [n_levels, N, 2] (ctrl_l, ctrl_r) correction bits
+    payload_cws: u32 [2, N] Field64 limb pair of the level's payload cw
+                 (value_len = 1, Poplar1's shape)
+    prefix_bits: u32 [n_levels, B] packed per-level prefix selection words
+    level:      target level; n_levels = level + 1 walk steps
+    -> ys raw limbs [2, P, N] (P = num_prefixes), bit-exact with
+       Idpf.eval(...) per lane.
+    """
+    N = seeds.shape[0]
+    nxt, ctrl, rkp = _walk(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
+                           prefix_bits, level)
+    # value block: candidate = first 8 bytes of block j=1 of the CONVERT
+    # stream keyed by the PRE-convert seed `nxt`
+    vb = _prg_block_planes(nxt, rkp, LABEL_CONVERT, level, 1)
+    words = _planes_to_words(vb)  # [4, N, 32B] LE words
+    lo = words[0]  # [N, 32B]
+    hi = words[1] & _U32(0x7FFFFFFF)  # oracle clears the chunk's top bit
+    ys = jnp.stack([jnp.transpose(lo, (1, 0)),
+                    jnp.transpose(hi, (1, 0))], axis=0)  # [2, 32B, N]
+    ys = ys[:, :num_prefixes]
 
     from janus_tpu.ops import field64 as f64
 
@@ -167,6 +180,45 @@ def eval_inner_level(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
     party_b = jnp.asarray(parties, dtype=bool)[None, :]  # [1, N] -> [P, N]
     ys = f64.select(jnp.broadcast_to(party_b, ctrl_bits.shape), neg, ys)
     return ys
+
+
+def eval_leaf_level(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
+                    payload_cws, prefix_bits, level: int, num_prefixes: int):
+    """Evaluate every (report, prefix) pair at the LEAF (Field255) level.
+
+    Same walk as eval_inner_level; the leaf convert consumes a 32-byte
+    candidate (CONVERT blocks j=1,2) with the top bit cleared, and the
+    payload correction/sign run in Field255 (janus_tpu.ops.field255).
+
+    payload_cws: u32 [8, N] Field255 limbs of the leaf payload cw.
+    -> (ys raw limbs [8, P, N], reject [P, N] bool).  reject marks lanes
+       whose candidate fell in [p, 2^255) — probability 19/2^255, i.e.
+       never in practice — where the oracle would redraw (host fallback).
+    """
+    from janus_tpu.ops import field255 as f255
+
+    N = seeds.shape[0]
+    nxt, ctrl, rkp = _walk(fixed_keys, seeds, parties, cw_seeds, cw_ctrls,
+                           prefix_bits, level)
+    vb1 = _prg_block_planes(nxt, rkp, LABEL_CONVERT, level, 1)
+    vb2 = _prg_block_planes(nxt, rkp, LABEL_CONVERT, level, 2)
+    w1 = _planes_to_words(vb1)  # [4, N, 32B] LE words (bytes 0..15)
+    w2 = _planes_to_words(vb2)  # bytes 16..31
+    limbs = [w1[0], w1[1], w1[2], w1[3], w2[0], w2[1], w2[2],
+             w2[3] & _U32(0x7FFFFFFF)]  # top bit cleared (sign bit)
+    ys = jnp.stack([jnp.transpose(w, (1, 0)) for w in limbs],
+                   axis=0)[:, :num_prefixes]  # [8, P, N]
+    reject = f255.geq_p(ys)  # [P, N]
+
+    ctrl_bits = _unpack_bits(ctrl, num_prefixes)  # bool [P, N]
+    # canonicalize flagged lanes to 0 so downstream field ops stay in range
+    ys = f255.select(reject, f255.zeros(ys.shape[1:]), ys)
+    corrected = f255.add(ys, jnp.asarray(payload_cws)[:, None, :])
+    ys = f255.select(ctrl_bits, corrected, ys)
+    neg = f255.neg(ys)
+    party_b = jnp.asarray(parties, dtype=bool)[None, :]
+    ys = f255.select(jnp.broadcast_to(party_b, ctrl_bits.shape), neg, ys)
+    return ys, jnp.any(reject, axis=0)
 
 
 def _unpack_bits(words, n: int):

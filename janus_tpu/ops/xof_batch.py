@@ -186,3 +186,43 @@ def seed_bytes_to_u8(seeds) -> jnp.ndarray:
     if isinstance(seeds, (list, tuple)):
         return jnp.asarray(np.frombuffer(b"".join(seeds), dtype=np.uint8).reshape(len(seeds), -1))
     return jnp.asarray(seeds, dtype=_U8)
+
+
+_P255 = (1 << 255) - 19
+_P255_LIMBS = tuple((_P255 >> (32 * i)) & 0xFFFFFFFF for i in range(8))
+
+
+def expand_field255(batch_shape: tuple, parts, n: int):
+    """Sample n Field255 elements per report (Poplar1 leaf sketch).
+
+    Field255 rejection is the hard case: a 32-byte candidate is accepted
+    with probability p/2^256 ~ 1/2 (the oracle does NOT clear the sign bit
+    here — only the IDPF leaf convert does), so speculative "exactly n
+    candidates" sampling would fail half the time.  Instead we OVERSAMPLE
+    K = 2n + 6*sqrt(2n) + 8 candidates (~2^-9 shortfall probability via the
+    normal tail) and COMPACT the accepted ones in order on device with a
+    stable argsort over the candidate axis.  Where reject=False the output
+    equals the oracle's rejection-sampled stream bit-for-bit, because both
+    consume candidates in stream order and keep the first n accepted.
+
+    Returns (elems (8, n) + batch_shape uint32 raw limbs, reject [*batch]).
+    """
+    K = 2 * n + int(6 * (2 * n) ** 0.5) + 8
+    lo, hi = _squeeze_lanes(build_blocks(batch_shape, parts), 4 * K)
+    # candidate j = lanes 4j..4j+3; LE limb order within the 32-byte chunk
+    limbs = jnp.stack([lo[0::4], hi[0::4], lo[1::4], hi[1::4],
+                       lo[2::4], hi[2::4], lo[3::4], hi[3::4]],
+                      axis=0)  # (8, K) + batch
+    eq = jnp.ones((K,) + batch_shape, dtype=bool)
+    gt = jnp.zeros((K,) + batch_shape, dtype=bool)
+    for i in range(7, -1, -1):
+        c = jnp.asarray(np.uint32(_P255_LIMBS[i]))
+        gt = gt | (eq & (limbs[i] > c))
+        eq = eq & (limbs[i] == c)
+    accept = ~(gt | eq)  # (K,) + batch
+    # stable order: accepted candidates first, stream order preserved
+    order = jnp.argsort(~accept, axis=0, stable=True)  # (K,) + batch
+    take = order[:n]  # (n,) + batch
+    elems = jnp.take_along_axis(limbs, take[None], axis=1)  # (8, n) + batch
+    reject = jnp.sum(accept, axis=0) < n
+    return elems, reject

@@ -137,16 +137,95 @@ def test_batch_poplar1_matches_host_engine():
     assert finished == len(nonces)
 
 
-def test_batch_poplar1_leaf_level_falls_back_to_host():
+def test_eval_leaf_level_matches_oracle():
+    """The Field255 leaf level on device, bit-exact with Idpf.eval."""
+    from janus_tpu.ops import field255 as f255
+    from janus_tpu.ops.idpf_batch import eval_leaf_level
+
+    bits = 6
+    level = bits - 1
+    prefixes = [0, 5, 21, 33, 62, 63]
+    n = 5
+    for party in (0, 1):
+        keys0, keys1, idpfs, nonces = _keys(bits, n)
+        keys = keys0 if party == 0 else keys1
+        N = n
+        fixed = np.stack([
+            np.frombuffer(idpf_mod._fixed_key(nc, b"janus-tpu idpf"),
+                          dtype=np.uint8) for nc in nonces])
+        seeds = np.stack([np.frombuffer(k.seed, dtype=np.uint8) for k in keys])
+        n_levels = level + 1
+        cw_seeds = np.zeros((n_levels, N, 16), dtype=np.uint8)
+        cw_ctrls = np.zeros((n_levels, N, 2), dtype=np.uint8)
+        payload = np.zeros((8, N), dtype=np.uint32)
+        for k_i, key in enumerate(keys):
+            for lv in range(n_levels):
+                cs, cl, cr = key.seed_cws[lv]
+                cw_seeds[lv, k_i] = np.frombuffer(cs, dtype=np.uint8)
+                cw_ctrls[lv, k_i] = (cl, cr)
+            pcw = key.payload_cws[level][0]
+            for j in range(8):
+                payload[j, k_i] = (pcw >> (32 * j)) & 0xFFFFFFFF
+        pb = pack_prefix_bits(prefixes, level, n_levels)
+        parties = np.full((N,), bool(party))
+        ys_d, rej_d = eval_leaf_level(
+            fixed, seeds, parties, cw_seeds, cw_ctrls, payload, pb, level,
+            len(prefixes))
+        ys, rej = np.asarray(ys_d), np.asarray(rej_d)
+        assert not rej.any()  # rejection probability is 19/2^255
+        for k_i, key in enumerate(keys):
+            want = [v[0] for v in idpfs[k_i].eval(key, level, list(prefixes))]
+            got = [int(v) for v in f255.unpack(ys[:, :, k_i])]
+            assert got == want, f"party={party} report={k_i}"
+
+
+def test_batch_poplar1_leaf_level_on_device():
+    """The full Poplar1 leaf prepare (walk + Field255 sketch) runs on device
+    and matches the host engine bit for bit, through finished out-shares."""
     vdaf = new_poplar1(4)
-    ap = encode_agg_param(3, [0, 5, 15])  # leaf level (Field255)
+    level, prefixes = 3, [0, 5, 9, 15]  # leaf level (Field255)
+    ap = encode_agg_param(level, prefixes)
+    verify_key = bytes(range(16))
+
+    host = HostPrepEngine(vdaf).bind(ap)
     dev = BatchPoplar1(vdaf, device_min_batch=1).bind(ap)
-    assert not dev._device_eligible()
-    verify_key = bytes(16)
-    nonce = bytes(range(16))
-    rand = bytes(j % 256 for j in range(vdaf.RAND_SIZE))
-    pub, ishares = vdaf.shard(9, nonce, rand)
-    res = dev.leader_init_batch(
-        verify_key, [nonce], [vdaf.encode_public_share(pub)],
-        [vdaf.encode_input_share(0, ishares[0])])
-    assert res[0].status == "continued"
+    assert dev._device_eligible()
+
+    nonces, pubs, shares0, shares1, inits = [], [], [], [], []
+    for i in range(5):
+        nonce = (i + 1).to_bytes(16, "big")
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard((i * 5) % 16, nonce, rand)
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares0.append(vdaf.encode_input_share(0, ishares[0]))
+        shares1.append(vdaf.encode_input_share(1, ishares[1]))
+
+    res_d = dev.leader_init_batch(verify_key, nonces, pubs, shares0)
+    res_h = host.leader_init_batch(verify_key, nonces, pubs, shares0)
+    for a, b in zip(res_d, res_h):
+        assert a.status == b.status == "continued"
+        assert a.outbound.encode() == b.outbound.encode()
+        assert a.state.prep_state.out_share == b.state.prep_state.out_share
+        assert a.state.prep_state.poplar == b.state.prep_state.poplar
+        inits.append(a.outbound)
+
+    res_dh = dev.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    res_hh = host.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    bound = vdaf.with_agg_param(ap)
+    from janus_tpu.vdaf.idpf import Field255
+
+    for i, (a, b) in enumerate(zip(res_dh, res_hh)):
+        assert a.status == b.status == "continued"
+        assert a.outbound.encode() == b.outbound.encode()
+        assert a.prep_share == b.prep_share
+        # finish both parties; the combined leaf out-shares must verify
+        t = ping_pong.continued(bound, res_d[i].state, a.outbound)
+        st, msg = t.evaluate()
+        helper_fin = ping_pong.continued(bound, a.state, msg)
+        assert getattr(helper_fin, "finished", False)
+        combined = [Field255.add(x, y) for x, y in
+                    zip(st.out_share, helper_fin.out_share)]
+        alpha_prefix = ((i * 5) % 16) >> (4 - 1 - level)
+        want = [1 if p == alpha_prefix else 0 for p in prefixes]
+        assert combined == want
