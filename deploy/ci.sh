@@ -11,6 +11,9 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 echo "== tests =="
 python -m pytest tests/ -x -q
 
+echo "== interop conformance selftest =="
+python -m janus_tpu.interop
+
 echo "== composed-services end-to-end =="
 python deploy/compose_e2e.py
 

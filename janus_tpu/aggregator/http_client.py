@@ -15,13 +15,19 @@ class PeerHttpError(Exception):
 
 
 class PeerClient:
-    def __init__(self, session=None, backoff: Backoff | None = None):
+    def __init__(self, session=None, backoff: Backoff | None = None,
+                 timeout: float = 180.0):
         if session is None:
             import requests
 
             session = requests.Session()
         self.session = session
         self.backoff = backoff
+        # Generous default: a helper's FIRST aggregation request for a new
+        # (vdaf, batch-bucket) shape pays the XLA compile inside the request
+        # (minutes on a cold CPU cache); lease expiry, not the socket, is
+        # the liveness mechanism (reference job_driver.rs:225).
+        self.timeout = timeout
 
     def send_to_helper(self, task: AggregatorTask, method: str, path: str,
                        body: bytes, content_type: str) -> HttpResult:
@@ -36,7 +42,8 @@ class PeerClient:
         def attempt() -> HttpResult:
             try:
                 resp = self.session.request(method, url, data=body,
-                                            headers=headers, timeout=30)
+                                            headers=headers,
+                                            timeout=self.timeout)
             except OSError:
                 raise
             except Exception as e:  # requests wraps connection errors

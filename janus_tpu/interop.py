@@ -339,3 +339,113 @@ class InteropCollector(_JsonHttpServer):
         if pbs.batch_identifier is not None:
             out["batch_id"] = _b64(bytes(pbs.batch_identifier))
         return out
+
+
+def selftest() -> int:
+    """Self-paired conformance run, one command (reference
+    interop_binaries/tests/end_to_end.rs:42 "Test Runner Operation"):
+    start all four interop servers in-process, drive the full upload →
+    aggregate → collect flow through the draft-dcook-ppm-dap-interop-
+    test-design JSON API only, and check the exact aggregate.
+
+        python -m janus_tpu.interop
+    """
+    import base64
+
+    import requests
+
+    from janus_tpu.aggregator.aggregation_job_creator import AggregationJobCreator
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import ephemeral_datastore
+    from janus_tpu.messages import TaskId, Time
+
+    clock = MockClock(Time(1_700_000_000))
+    leader_ds, helper_ds = ephemeral_datastore(clock), ephemeral_datastore(clock)
+    client = leader = helper = collector = None
+    client = InteropClient().start()
+    leader = InteropAggregator(leader_ds, clock).start()
+    helper = InteropAggregator(helper_ds, clock).start()
+    collector = InteropCollector().start()
+    sess = requests.Session()
+    try:
+        for srv in (client, leader, helper, collector):
+            assert sess.post(f"{srv.address}/internal/test/ready",
+                             json={}).status_code == 200
+        leader_dap = sess.post(
+            f"{leader.address}/internal/test/endpoint_for_task",
+            json={}).json()["endpoint"]
+        helper_dap = sess.post(
+            f"{helper.address}/internal/test/endpoint_for_task",
+            json={}).json()["endpoint"]
+
+        task_id = TaskId.random()
+        vk_b64 = base64.urlsafe_b64encode(bytes(range(16))).rstrip(b"=").decode()
+        vdaf = {"type": "Prio3Sum", "bits": "8"}
+        r = sess.post(f"{collector.address}/internal/test/add_task", json={
+            "task_id": str(task_id), "leader": leader_dap, "vdaf": vdaf,
+            "collector_authentication_token": "collector-token",
+            "query_type": 1,
+        }).json()
+        assert r["status"] == "success", r
+        collector_hpke_config = r["collector_hpke_config"]
+        for srv, role in ((leader, "leader"), (helper, "helper")):
+            r = sess.post(f"{srv.address}/internal/test/add_task", json={
+                "task_id": str(task_id), "leader": leader_dap,
+                "helper": helper_dap, "vdaf": vdaf,
+                "leader_authentication_token": "leader-token",
+                "collector_authentication_token":
+                    "collector-token" if role == "leader" else None,
+                "role": role, "vdaf_verify_key": vk_b64,
+                "max_batch_query_count": 1, "query_type": 1,
+                "min_batch_size": 3, "time_precision": 3600,
+                "collector_hpke_config": collector_hpke_config,
+            }).json()
+            assert r["status"] == "success", r
+
+        for meas in ("11", "22", "33"):
+            r = sess.post(f"{client.address}/internal/test/upload", json={
+                "task_id": str(task_id), "leader": leader_dap,
+                "helper": helper_dap, "vdaf": vdaf, "measurement": meas,
+                "time": 1_700_000_000, "time_precision": 3600,
+            }).json()
+            assert r["status"] == "success", r
+
+        leader.aggregator.report_writer.flush()
+        AggregationJobCreator(leader_ds, 1, 10,
+                              batch_aggregation_shard_count=2).run_once()
+        drv = AggregationJobDriver(leader_ds, batch_aggregation_shard_count=2)
+        JobDriver(JobDriverConfig(), drv.acquirer, drv.stepper).run_once()
+
+        r = sess.post(f"{collector.address}/internal/test/collection_start",
+                      json={
+                          "task_id": str(task_id), "agg_param": "",
+                          "query": {
+                              "type": 1,
+                              "batch_interval_start":
+                                  1_699_998_000 // 3600 * 3600,
+                              "batch_interval_duration": 2 * 3600,
+                          },
+                      }).json()
+        assert r["status"] == "success", r
+        handle = r["handle"]
+        cdrv = CollectionJobDriver(leader_ds)
+        JobDriver(JobDriverConfig(), cdrv.acquirer, cdrv.stepper).run_once()
+        r = sess.post(f"{collector.address}/internal/test/collection_poll",
+                      json={"handle": handle}).json()
+        assert r["status"] == "complete", r
+        assert r["report_count"] == 3 and r["result"] == "66", r
+        print("interop selftest OK: 3 reports, aggregate=66")
+        return 0
+    finally:
+        for srv in (client, leader, helper, collector):
+            if srv is not None:
+                srv.stop()
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _sys.exit(selftest())
