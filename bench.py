@@ -174,12 +174,69 @@ def time_host_oracle(engine, verify_key, nonces, pubs, shares, inits, n=8):
     return n / dt
 
 
+def bench_poplar1(smoke: bool) -> dict:
+    """Poplar1 heavy-hitters LEAF level on device (Field255 walk + sketch) —
+    the round-2 known gap, now a kernel (ops/field255.py, eval_leaf_level).
+    Reports helper-side prepare throughput at the most expensive level."""
+    from janus_tpu.engine.batch_poplar1 import BatchPoplar1
+    from janus_tpu.engine.host import HostPrepEngine
+    from janus_tpu.vdaf.poplar1 import encode_agg_param, new_poplar1
+
+    bits = 8
+    n = 64 if smoke else 2048
+    prefixes = list(range(16))
+    ap = encode_agg_param(bits - 1, prefixes)  # leaf level, 16 candidates
+    vdaf = new_poplar1(bits)
+    engine = BatchPoplar1(vdaf, device_min_batch=1).bind(ap)
+    verify_key = bytes(range(16))
+    n_base = 8
+    nonces, pubs, shares, inits = [], [], [], []
+    from janus_tpu.vdaf import ping_pong as pp
+
+    bound = vdaf.with_agg_param(ap)
+    for i in range(n_base):
+        nonce = i.to_bytes(16, "big")
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard((i * 37) % (1 << bits), nonce, rand)
+        _st, msg = pp.leader_initialized(
+            bound, verify_key, nonce, pub, ishares[0])
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares.append(vdaf.encode_input_share(1, ishares[1]))
+        inits.append(msg)
+    nonces, pubs, shares, inits = (
+        tile(xs, n) for xs in (nonces, pubs, shares, inits))
+    host = HostPrepEngine(vdaf).bind(ap)
+    t0 = time.perf_counter()
+    host.helper_init_batch(verify_key, nonces[:4], pubs[:4], shares[:4],
+                           inits[:4])
+    host_rps = 4 / (time.perf_counter() - t0)
+    rps, rounds, _ = time_batches(engine, verify_key, nonces, pubs, shares,
+                                  inits, n, n, workers=1)
+    return {
+        "reports_per_sec": round(rps, 1),
+        "rounds": [round(r, 1) for r in rounds],
+        "level": "leaf (Field255)",
+        "prefixes": len(prefixes),
+        "batch_size": n,
+        "host_oracle_reports_per_sec": round(host_rps, 2),
+        "speedup_vs_host_oracle": round(rps / host_rps, 1),
+        "host_fallbacks": engine.fallback_count,
+    }
+
+
 def main():
     smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
     only = os.environ.get("BENCH_CONFIGS")
     only = set(only.split(",")) if only else None
     platform = jax.devices()[0].platform
     detail = {}
+
+    if only is None or "Poplar1LeafLevel" in only:
+        try:
+            detail["Poplar1LeafLevel"] = bench_poplar1(smoke)
+        except Exception as e:  # keep the harness unattended-safe
+            detail["Poplar1LeafLevel"] = {"error": f"{type(e).__name__}: {e}"}
 
     for name, factory, meas, total, batch in make_configs(smoke):
         if only and name not in only:

@@ -35,6 +35,11 @@ class Counter:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def snapshot(self) -> list[tuple]:
+        """[(label_key, value)] for exporters (janus_tpu.otlp)."""
+        with self._lock:
+            return sorted(self._values.items())
+
     def _render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
@@ -64,6 +69,12 @@ class Histogram:
         key = tuple(sorted(labels.items()))
         with self._lock:
             return sum(self._counts.get(key, ()))
+
+    def snapshot(self) -> list[tuple]:
+        """[(label_key, bucket_counts, sum)] for exporters."""
+        with self._lock:
+            return [(key, list(counts), self._sums.get(key, 0.0))
+                    for key, counts in sorted(self._counts.items())]
 
     def _render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -121,6 +132,10 @@ class Registry:
             lines.extend(m_._render())
         return "\n".join(lines) + "\n"
 
+    def all(self) -> list:
+        with self._lock:
+            return list(self._metrics)
+
 
 REGISTRY = Registry()
 
@@ -145,3 +160,8 @@ device_batch_seconds = REGISTRY.histogram(
     "janus_device_batch_seconds", "device prepare-kernel latency by batch bucket")
 device_batch_reports = REGISTRY.counter(
     "janus_device_batch_reports", "reports processed by the device engine")
+
+
+def all_instruments() -> list:
+    """Every registered instrument, for exporters (janus_tpu.otlp)."""
+    return REGISTRY.all()

@@ -1,0 +1,198 @@
+"""OTLP/HTTP export for metrics and trace spans (reference
+aggregator/src/metrics.rs OTLP feature and trace.rs:36-89
+OtlpTraceConfiguration; SURVEY.md §5.1/§5.5).
+
+Dependency-free: uses the OTLP/HTTP **JSON** encoding (a first-class OTLP
+wire format) so no protobuf stack is needed.  A background thread
+periodically snapshots the in-process metrics registry
+(janus_tpu.metrics) and POSTs it to `{endpoint}/v1/metrics`; trace spans
+are buffered by a span processor hooked into janus_tpu.trace and flushed
+to `{endpoint}/v1/traces`.
+
+Wire-up (mirrors the reference's config split):
+
+    from janus_tpu.otlp import OtlpConfig, install_otlp_exporter
+    install_otlp_exporter(OtlpConfig(endpoint="http://collector:4318"))
+
+Failures are swallowed after logging once — observability export must
+never take the data plane down.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OtlpConfig:
+    """reference trace.rs:89 OtlpTraceConfiguration + metrics analog."""
+
+    endpoint: str = "http://localhost:4318"
+    interval_s: float = 30.0
+    service_name: str = "janus_tpu"
+    headers: dict = field(default_factory=dict)  # e.g. auth metadata
+
+
+def _now_ns() -> int:
+    return time.time_ns()
+
+
+def _resource(cfg: OtlpConfig) -> dict:
+    return {"attributes": [
+        {"key": "service.name", "value": {"stringValue": cfg.service_name}},
+    ]}
+
+
+def _attr_list(labels) -> list:
+    return [{"key": str(k), "value": {"stringValue": str(v)}}
+            for k, v in labels]
+
+
+class OtlpExporter:
+    def __init__(self, cfg: OtlpConfig, registry=None):
+        self.cfg = cfg
+        if registry is None:
+            from janus_tpu import metrics as registry
+        # accept either the metrics module (all_instruments) or a bare
+        # Registry instance (.all)
+        self._instruments = getattr(registry, "all_instruments", None) \
+            or registry.all
+        self._spans: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._warned = False
+
+    # -- metrics -----------------------------------------------------------
+
+    def _metric_payload(self) -> dict:
+        ms = []
+        for inst in self._instruments():
+            if hasattr(inst, "buckets"):  # histogram
+                points = []
+                for key, counts, total in inst.snapshot():
+                    points.append({
+                        "attributes": _attr_list(key),
+                        "timeUnixNano": str(_now_ns()),
+                        "count": str(sum(counts)),
+                        "sum": total,
+                        "bucketCounts": [str(c) for c in counts],
+                        "explicitBounds": list(inst.buckets),
+                    })
+                ms.append({"name": inst.name, "description": inst.help,
+                           "histogram": {"aggregationTemporality": 2,
+                                         "dataPoints": points}})
+            else:  # counter
+                points = [{
+                    "attributes": _attr_list(key),
+                    "timeUnixNano": str(_now_ns()),
+                    "asDouble": v,
+                } for key, v in inst.snapshot()]
+                ms.append({"name": inst.name, "description": inst.help,
+                           "sum": {"aggregationTemporality": 2,
+                                   "isMonotonic": True,
+                                   "dataPoints": points}})
+        return {"resourceMetrics": [{
+            "resource": _resource(self.cfg),
+            "scopeMetrics": [{"scope": {"name": "janus_tpu"},
+                              "metrics": ms}],
+        }]}
+
+    # -- spans -------------------------------------------------------------
+
+    def on_span(self, name: str, start_ns: int, end_ns: int, fields: dict,
+                trace_id: str, span_id: str,
+                parent_span_id: str | None = None) -> None:
+        span = {
+            "traceId": trace_id, "spanId": span_id, "name": name,
+            "kind": 1,
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": _attr_list(fields.items()),
+        }
+        if parent_span_id:
+            span["parentSpanId"] = parent_span_id
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > 4096:  # bound memory; drop oldest
+                del self._spans[:2048]
+
+    def _span_payload(self) -> dict | None:
+        with self._lock:
+            spans, self._spans = self._spans, []
+        if not spans:
+            return None
+        return {"resourceSpans": [{
+            "resource": _resource(self.cfg),
+            "scopeSpans": [{"scope": {"name": "janus_tpu"},
+                            "spans": spans}],
+        }]}
+
+    # -- transport ---------------------------------------------------------
+
+    def _post(self, path: str, payload: dict) -> None:
+        import requests
+
+        try:
+            resp = requests.post(
+                self.cfg.endpoint.rstrip("/") + path,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json",
+                         **self.cfg.headers},
+                timeout=10,
+            )
+            if resp.status_code >= 400:
+                self._warn_once(f"collector returned {resp.status_code}")
+        except Exception as e:
+            self._warn_once(str(e))
+
+    def _warn_once(self, error: str) -> None:
+        if not self._warned:
+            self._warned = True
+            from janus_tpu import trace
+
+            trace.warn("otlp export failed (suppressing further warnings)",
+                       error=error, endpoint=self.cfg.endpoint)
+
+    def flush(self) -> None:
+        self._post("/v1/metrics", self._metric_payload())
+        sp = self._span_payload()
+        if sp is not None:
+            self._post("/v1/traces", sp)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.interval_s):
+            self.flush()
+        self.flush()  # final flush on stop
+
+    def start(self) -> "OtlpExporter":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="otlp-exporter")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # the final flush can take two sequential 10s post timeouts
+            self._thread.join(timeout=25)
+
+
+_installed: OtlpExporter | None = None
+
+
+def install_otlp_exporter(cfg: OtlpConfig, registry=None) -> OtlpExporter:
+    """Start the periodic exporter and hook span completion into
+    janus_tpu.trace (the analog of the reference's feature-gated OTLP
+    layers)."""
+    global _installed
+    if _installed is not None:
+        _installed.stop()
+    _installed = OtlpExporter(cfg, registry).start()
+    from janus_tpu import trace
+
+    trace.set_span_sink(_installed.on_span)
+    return _installed

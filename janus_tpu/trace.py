@@ -44,7 +44,8 @@ class _Subscriber:
         self._lock = threading.Lock()
         self._local = threading.local()
 
-    def _path(self) -> list[str]:
+    def _path(self) -> list:
+        """Thread-local span stack: (name, span_id_hex) entries."""
         if not hasattr(self._local, "spans"):
             self._local.spans = []
         return self._local.spans
@@ -52,7 +53,7 @@ class _Subscriber:
     def emit(self, level: str, message: str, **fields) -> None:
         if _LEVELS[level] > self.level:
             return
-        spans = ":".join(self._path())
+        spans = ":".join(e[0] for e in self._path())
         if self.cfg.use_json:
             record = {"ts": _time.time(), "level": level, "message": message,
                       "spans": spans, **fields}
@@ -67,8 +68,15 @@ class _Subscriber:
     @contextlib.contextmanager
     def span(self, name: str, **fields):
         path = self._path()
-        path.append(name)
+        # one trace id per thread-local root span; spans nest under their
+        # parent's span id so exporters see a single correlated trace
+        if not path:
+            self._local.trace_id = os.urandom(16).hex()
+        parent_id = path[-1][1] if path else None
+        span_id = os.urandom(8).hex()
+        path.append((name, span_id))
         t0 = _time.monotonic()
+        t0_ns = _time.time_ns()
         try:
             yield
         finally:
@@ -77,6 +85,14 @@ class _Subscriber:
             self.emit("debug", f"{name} done", duration_ms=round(1e3 * dt, 2),
                       **fields)
             path.pop()
+            sink = _span_sink
+            if sink is not None:
+                try:
+                    sink(name, t0_ns, t0_ns + int(dt * 1e9), fields,
+                         self._local.trace_id, span_id, parent_id)
+                except Exception:
+                    # observability must never take the data plane down
+                    pass
 
 
 _subscriber: _Subscriber | None = None
@@ -122,3 +138,13 @@ def warn(message: str, **fields) -> None:
 
 def error(message: str, **fields) -> None:
     event("error", message, **fields)
+
+
+_span_sink = None
+
+
+def set_span_sink(sink) -> None:
+    """Register a completed-span callback (janus_tpu.otlp exporter):
+    sink(name, start_ns, end_ns, fields, trace_id_hex, span_id_hex)."""
+    global _span_sink
+    _span_sink = sink

@@ -1,0 +1,97 @@
+"""OTLP/HTTP JSON export: metrics snapshots and span flushing against a
+local capture endpoint (reference trace.rs:36-89 / metrics.rs OTLP
+features)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from janus_tpu import metrics, trace
+from janus_tpu.otlp import OtlpConfig, OtlpExporter, install_otlp_exporter
+
+
+class _Capture(BaseHTTPRequestHandler):
+    received: list = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        type(self).received.append((self.path, json.loads(body)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+def _server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Capture)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_port}"
+
+
+def test_metric_and_span_export():
+    _Capture.received = []
+    srv, endpoint = _server()
+    try:
+        c = metrics.REGISTRY.counter("janus_otlp_test_counter", "test")
+        h = metrics.REGISTRY.histogram("janus_otlp_test_hist", "test")
+        c.add(3, kind="x")
+        h.observe(0.2, kind="y")
+
+        exp = install_otlp_exporter(OtlpConfig(endpoint=endpoint,
+                                               interval_s=3600))
+        with trace.span("otlp test span", task="t1"):
+            pass
+        exp.flush()
+
+        paths = [p for p, _ in _Capture.received]
+        assert "/v1/metrics" in paths
+        assert "/v1/traces" in paths
+        mpayload = next(b for p, b in _Capture.received if p == "/v1/metrics")
+        names = [m["name"]
+                 for rm in mpayload["resourceMetrics"]
+                 for sm in rm["scopeMetrics"]
+                 for m in sm["metrics"]]
+        assert "janus_otlp_test_counter" in names
+        assert "janus_otlp_test_hist" in names
+        cm = next(m for rm in mpayload["resourceMetrics"]
+                  for sm in rm["scopeMetrics"] for m in sm["metrics"]
+                  if m["name"] == "janus_otlp_test_counter")
+        pt = cm["sum"]["dataPoints"][0]
+        assert pt["asDouble"] == 3.0
+        assert {"key": "kind", "value": {"stringValue": "x"}} in pt["attributes"]
+
+        tpayload = next(b for p, b in _Capture.received if p == "/v1/traces")
+        spans = [s for rs in tpayload["resourceSpans"]
+                 for ss in rs["scopeSpans"] for s in ss["spans"]]
+        assert any(s["name"] == "otlp test span" for s in spans)
+        sp = next(s for s in spans if s["name"] == "otlp test span")
+        assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+        exp.stop()
+    finally:
+        trace.set_span_sink(None)
+        srv.shutdown()
+
+
+def test_export_failure_is_swallowed():
+    exp = OtlpExporter(OtlpConfig(endpoint="http://127.0.0.1:9",  # closed
+                                  interval_s=3600))
+    metrics.REGISTRY.counter("janus_otlp_test_counter2", "t").add(1)
+    exp.flush()  # must not raise
+
+
+def test_nested_spans_share_a_trace():
+    """Nested spans export one traceId with parentSpanId links."""
+    captured = []
+    trace.set_span_sink(lambda *a: captured.append(a))
+    try:
+        with trace.span("outer"):
+            with trace.span("inner"):
+                pass
+    finally:
+        trace.set_span_sink(None)
+    assert [c[0] for c in captured] == ["inner", "outer"]
+    inner, outer = captured
+    assert inner[4] == outer[4]          # same trace id
+    assert inner[6] == outer[5]          # inner's parent == outer's span id
+    assert outer[6] is None              # root has no parent
