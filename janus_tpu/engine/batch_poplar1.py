@@ -54,7 +54,7 @@ class BatchPoplar1(HostPrepEngine):
         # jitted-kernel cache, SHARED with every bound copy (the aggregator
         # binds a fresh engine per job; a per-instance cache would recompile
         # per request).  Keyed on everything the kernel closure bakes in:
-        # (bucketed N, P, level, party, verify_key).
+        # (bucketed N, P, level, party) — the verify key is a runtime input.
         self._fns = {} if _fns is None else _fns
         # below this many reports the jit dispatch (and on cold caches the
         # compile) costs more than the host loop; small service batches take

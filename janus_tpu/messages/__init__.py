@@ -958,8 +958,28 @@ class AggregationJobContinueReq(WireMessage):
 
     @classmethod
     def decode_from(cls, cur: Cursor) -> "AggregationJobContinueReq":
-        return cls(AggregationJobStep.decode_from(cur),
-                   tuple(decode_vec32(cur, PrepareContinue.decode_from)))
+        step = AggregationJobStep.decode_from(cur)
+        continues = cls._decode_continues_native(cur)
+        if continues is None:
+            continues = tuple(decode_vec32(cur, PrepareContinue.decode_from))
+        return cls(step, continues)
+
+    @classmethod
+    def _decode_continues_native(cls, cur: Cursor):
+        """Fast path: one C++ pass over the PrepareContinue vector
+        (janus_tpu.native); None -> Python codec fallback."""
+        from janus_tpu import native
+
+        if not native.available():
+            return None
+        body = cur.opaque32()
+        table = native.parse_prepare_continues(body)
+        if table is None:
+            raise DecodeError("malformed PrepareContinue vector")
+        return tuple(
+            PrepareContinue(ReportId(body[io : io + 16]),
+                            body[mo : mo + ml])
+            for io, mo, ml in table.tolist())
 
 
 @dataclass(frozen=True)
@@ -969,11 +989,66 @@ class AggregationJobResp(WireMessage):
     prepare_resps: tuple[PrepareResp, ...]
 
     def encode(self) -> bytes:
-        return encode_vec32(self.prepare_resps)
+        out = self._encode_native()
+        return out if out is not None else encode_vec32(self.prepare_resps)
+
+    def _encode_native(self) -> bytes | None:
+        """Fast path: the PrepareResp vector body is emitted in one C++ pass
+        (janus_tpu.native.build_prepare_resps); None -> Python codec."""
+        from janus_tpu import native
+
+        if not native.available() or not self.prepare_resps:
+            return None
+        n = len(self.prepare_resps)
+        ids = bytearray(n * 16)
+        kinds = bytearray(n)
+        errors = bytearray(n)
+        messages = []
+        for k, pr in enumerate(self.prepare_resps):
+            ids[k * 16 : (k + 1) * 16] = bytes(pr.report_id)
+            r = pr.result
+            kinds[k] = r.kind
+            if r.kind == PrepareStepResult.CONTINUE:
+                messages.append(r.message)
+            else:
+                messages.append(b"")
+                if r.kind == PrepareStepResult.REJECT:
+                    errors[k] = int(r.error)
+        return native.build_prepare_resps(bytes(ids), kinds, errors, messages)
 
     @classmethod
     def decode_from(cls, cur: Cursor) -> "AggregationJobResp":
-        return cls(tuple(decode_vec32(cur, PrepareResp.decode_from)))
+        resps = cls._decode_native(cur)
+        if resps is None:
+            resps = tuple(decode_vec32(cur, PrepareResp.decode_from))
+        return cls(resps)
+
+    @classmethod
+    def _decode_native(cls, cur: Cursor):
+        """Fast path: one C++ pass over the PrepareResp vector
+        (janus_tpu.native); None -> Python codec fallback."""
+        from janus_tpu import native
+
+        if not native.available():
+            return None
+        body = cur.opaque32()
+        table = native.parse_prepare_resps(body)
+        if table is None:
+            raise DecodeError("malformed PrepareResp vector")
+        out = []
+        for io, kind, mo, ml, errv in table.tolist():
+            if kind == PrepareStepResult.CONTINUE:
+                result = PrepareStepResult(kind, message=body[mo : mo + ml])
+            elif kind == PrepareStepResult.FINISHED:
+                result = PrepareStepResult(kind)
+            else:
+                try:
+                    perr = PrepareError(errv)
+                except ValueError as e:
+                    raise DecodeError(f"unknown prepare error {errv}") from e
+                result = PrepareStepResult(kind, error=perr)
+            out.append(PrepareResp(ReportId(body[io : io + 16]), result))
+        return tuple(out)
 
 
 # ---------------------------------------------------------------------------

@@ -70,6 +70,22 @@ def _load():
             lib.parse_prepare_inits.argtypes = [
                 ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
                 ctypes.POINTER(ctypes.c_int64)]
+            lib.parse_prepare_continues.restype = ctypes.c_long
+            lib.parse_prepare_continues.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.parse_prepare_resps.restype = ctypes.c_long
+            lib.parse_prepare_resps.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_int64)]
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.build_prepare_resps.restype = ctypes.c_long
+            lib.build_prepare_resps.argtypes = [
+                ctypes.c_long, ctypes.c_char_p, u8p, u8p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64), u8p, ctypes.c_long]
+            lib.checksum_report_ids.restype = None
+            lib.checksum_report_ids.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_long, u8p]
             _lib = lib
         except OSError:
             _lib = None
@@ -100,3 +116,84 @@ def parse_prepare_inits(data: bytes, max_reports: int | None = None):
     if n < 0:
         return None
     return out[:n]
+
+
+def parse_prepare_continues(data: bytes, max_reports: int | None = None):
+    """Scan a PrepareContinue vector body -> int64 offset table [n, 3] or
+    None (unavailable toolchain OR malformed input — the caller raises
+    DecodeError on None after checking available(), mirroring
+    parse_prepare_inits).
+
+    Columns: id_off, msg_off, msg_len."""
+    lib = _load()
+    if lib is None:
+        return None
+    if max_reports is None:
+        max_reports = max(1, len(data) // 20 + 1)  # >= 16 + 4 bytes each
+    out = np.empty((max_reports, 3), dtype=np.int64)
+    n = lib.parse_prepare_continues(
+        data, len(data), max_reports,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if n < 0:
+        return None
+    return out[:n]
+
+
+def parse_prepare_resps(data: bytes, max_reports: int | None = None):
+    """Scan a PrepareResp vector body -> int64 table [n, 5] or None.
+
+    Columns: id_off, kind, msg_off, msg_len, error."""
+    lib = _load()
+    if lib is None:
+        return None
+    if max_reports is None:
+        max_reports = max(1, len(data) // 17 + 1)  # >= 16 + 1 bytes each
+    out = np.empty((max_reports, 5), dtype=np.int64)
+    n = lib.parse_prepare_resps(
+        data, len(data), max_reports,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if n < 0:
+        return None
+    return out[:n]
+
+
+def build_prepare_resps(ids: bytes, kinds, errors, messages: list[bytes]):
+    """Emit an encoded AggregationJobResp body in one native pass, or None.
+
+    ids: n x 16 contiguous report ids; kinds/errors: uint8 arrays (kind
+    0=continue, 1=finished, 2=reject); messages: the continue payload per
+    lane (b"" for non-continue lanes)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(kinds)
+    kinds = np.ascontiguousarray(kinds, dtype=np.uint8)
+    errors = np.ascontiguousarray(errors, dtype=np.uint8)
+    msgs = b"".join(messages)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(m) for m in messages], out=offs[1:])
+    cap = 4 + n * (16 + 1 + 5) + len(msgs)
+    out = np.empty(cap, dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    wrote = lib.build_prepare_resps(
+        n, ids, kinds.ctypes.data_as(u8p), errors.ctypes.data_as(u8p),
+        msgs, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(u8p), cap)
+    if wrote < 0:
+        return None
+    return out[:wrote].tobytes()
+
+
+def checksum_report_ids(ids: bytes, seed: bytes = bytes(32)):
+    """XOR-of-SHA256 over n x 16 contiguous report ids, folded onto `seed`
+    (the existing checksum when continuing).  Returns 32 bytes or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    if len(ids) % 16 != 0 or len(seed) != 32:
+        raise ValueError("ids must be n*16 bytes and seed 32 bytes")
+    out = np.frombuffer(seed, dtype=np.uint8).copy()
+    lib.checksum_report_ids(
+        ids, len(ids) // 16,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out.tobytes()

@@ -134,9 +134,10 @@ class OtlpExporter:
     # -- transport ---------------------------------------------------------
 
     def _post(self, path: str, payload: dict) -> None:
-        import requests
-
         try:
+            import requests  # optional dep: a missing module must warn, not
+                             # kill the exporter thread
+
             resp = requests.post(
                 self.cfg.endpoint.rstrip("/") + path,
                 data=json.dumps(payload).encode(),

@@ -145,6 +145,7 @@ _span_sink = None
 
 def set_span_sink(sink) -> None:
     """Register a completed-span callback (janus_tpu.otlp exporter):
-    sink(name, start_ns, end_ns, fields, trace_id_hex, span_id_hex)."""
+    sink(name, start_ns, end_ns, fields, trace_id_hex, span_id_hex,
+    parent_span_id_hex_or_None)."""
     global _span_sink
     _span_sink = sink

@@ -90,4 +90,179 @@ void xor_digests(const uint8_t* digests, long n, uint8_t* out /* 32 */) {
         for (int i = 0; i < 32; ++i) out[i] ^= digests[k * 32 + i];
 }
 
+// PrepareContinue vector scanner (continue-direction hot path; layout
+// messages/src/lib.rs:2373): PrepareContinue = report_id[16] || opaque32
+// message.  Output row (3 x int64): [id_off, msg_off, msg_len].
+long parse_prepare_continues(const uint8_t* buf, long len, long max_reports,
+                             int64_t* out /* max_reports x 3 */) {
+    long off = 0;
+    long n = 0;
+    while (off < len) {
+        if (n >= max_reports) return -1;
+        int64_t* row = out + n * 3;
+        if (off + 16 + 4 > len) return -1;
+        row[0] = off;
+        off += 16;
+        uint32_t msg_len = rd32(buf + off);
+        off += 4;
+        if (off + msg_len > (uint64_t)len) return -1;
+        row[1] = off;
+        row[2] = msg_len;
+        off += msg_len;
+        ++n;
+    }
+    return off == len ? n : -1;
+}
+
+static inline void wr32(uint8_t* p, uint32_t v) {
+    p[0] = uint8_t(v >> 24); p[1] = uint8_t(v >> 16);
+    p[2] = uint8_t(v >> 8);  p[3] = uint8_t(v);
+}
+
+// One-pass AggregationJobResp body builder (messages lib.rs:2237,2283,2669):
+//   encode_vec32(PrepareResp) where
+//   PrepareResp       = report_id[16] || PrepareStepResult
+//   PrepareStepResult = 0 || opaque32 message  (continue)
+//                     | 1                      (finished)
+//                     | 2 || error u8          (reject)
+// Inputs: `ids` = n x 16 contiguous report ids; `kinds`/`errors` u8[n];
+// `msgs` = concatenated continue payloads with prefix offsets
+// `msg_offs` int64[n+1] (entries for non-continue lanes are ignored).
+// Writes the full body (u32 total length prefix included) into `out`;
+// returns bytes written, or -1 if `out_cap` is too small / kind invalid.
+long build_prepare_resps(long n, const uint8_t* ids, const uint8_t* kinds,
+                         const uint8_t* errors, const uint8_t* msgs,
+                         const int64_t* msg_offs, uint8_t* out, long out_cap) {
+    long off = 4;  // u32 vector length prefix, patched at the end
+    for (long k = 0; k < n; ++k) {
+        if (off + 16 + 1 > out_cap) return -1;
+        for (int i = 0; i < 16; ++i) out[off + i] = ids[k * 16 + i];
+        off += 16;
+        uint8_t kind = kinds[k];
+        out[off++] = kind;
+        if (kind == 0) {
+            int64_t m0 = msg_offs[k], m1 = msg_offs[k + 1];
+            int64_t mlen = m1 - m0;
+            if (mlen < 0 || off + 4 + mlen > out_cap) return -1;
+            wr32(out + off, (uint32_t)mlen);
+            off += 4;
+            for (int64_t i = 0; i < mlen; ++i) out[off + i] = msgs[m0 + i];
+            off += mlen;
+        } else if (kind == 2) {
+            if (off + 1 > out_cap) return -1;
+            out[off++] = errors[k];
+        } else if (kind != 1) {
+            return -1;
+        }
+    }
+    wr32(out, (uint32_t)(off - 4));
+    return off;
+}
+
+// PrepareResp vector scanner (leader side of the continue exchange;
+// layout messages lib.rs:2237,2283).  Output row (5 x int64):
+//   [id_off, kind, msg_off, msg_len, error]
+// msg_off/msg_len are 0 unless kind==0 (continue); error is 0 unless
+// kind==2 (reject).
+long parse_prepare_resps(const uint8_t* buf, long len, long max_reports,
+                         int64_t* out /* max_reports x 5 */) {
+    long off = 0;
+    long n = 0;
+    while (off < len) {
+        if (n >= max_reports) return -1;
+        int64_t* row = out + n * 5;
+        if (off + 16 + 1 > len) return -1;
+        row[0] = off;
+        off += 16;
+        uint8_t kind = buf[off++];
+        row[1] = kind;
+        row[2] = 0; row[3] = 0; row[4] = 0;
+        if (kind == 0) {
+            if (off + 4 > len) return -1;
+            uint32_t msg_len = rd32(buf + off);
+            off += 4;
+            if (off + msg_len > (uint64_t)len) return -1;
+            row[2] = off;
+            row[3] = msg_len;
+            off += msg_len;
+        } else if (kind == 2) {
+            if (off + 1 > len) return -1;
+            row[4] = buf[off++];
+        } else if (kind != 1) {
+            return -1;
+        }
+        ++n;
+    }
+    return off == len ? n : -1;
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4) — for the XOR-of-SHA256 report-id checksum
+// (reference core/src/report_id.rs; messages lib.rs:442).  Self-contained so
+// the checksum fold over every report id in an aggregation-job write
+// (aggregation_job_writer.py) runs as one native pass.
+
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+static inline uint32_t rotr(uint32_t x, int s) {
+    return (x >> s) | (x << (32 - s));
+}
+
+// One 64-byte block; id inputs are 16 bytes so a single padded block always
+// suffices (16 + 1 + 8 <= 64).
+static void sha256_block16(const uint8_t* id, uint8_t* digest /* 32 */) {
+    uint8_t block[64] = {0};
+    for (int i = 0; i < 16; ++i) block[i] = id[i];
+    block[16] = 0x80;
+    // bit length = 128 = 0x80, big-endian in the last 8 bytes
+    block[62] = 0x00;
+    block[63] = 0x80;
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i) w[i] = rd32(block + 4 * i);
+    for (int i = 16; i < 64; ++i) {
+        uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                     0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+        uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = hh + S1 + ch + K256[i] + w[i];
+        uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        hh = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+    for (int i = 0; i < 8; ++i) wr32(digest + 4 * i, h[i]);
+}
+
+// XOR-of-SHA256 over `n` 16-byte report ids, XORed onto `out` in place
+// (seed `out` with zeros or an existing checksum to continue a fold).
+void checksum_report_ids(const uint8_t* ids, long n, uint8_t* out /* 32 */) {
+    uint8_t digest[32];
+    for (long k = 0; k < n; ++k) {
+        sha256_block16(ids + k * 16, digest);
+        for (int i = 0; i < 32; ++i) out[i] ^= digest[i];
+    }
+}
+
 }  // extern "C"

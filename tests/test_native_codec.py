@@ -1,6 +1,7 @@
-"""Native C++ wire scanner (native/report_codec.cpp via janus_tpu.native):
+"""Native C++ wire codec (native/report_codec.cpp via janus_tpu.native):
 offset-table parity with the pure-Python codec, malformed-input rejection,
-and the AggregationJobInitializeReq fast path."""
+the AggregationJobInitializeReq / AggregationJobContinueReq fast paths, the
+one-pass AggregationJobResp builder, and the SHA-256 checksum fold."""
 
 import os
 import time
@@ -10,12 +11,20 @@ import pytest
 from janus_tpu import native
 from janus_tpu.messages import (
     TIME_INTERVAL,
+    AggregationJobContinueReq,
     AggregationJobInitializeReq,
+    AggregationJobResp,
+    AggregationJobStep,
     HpkeCiphertext,
     HpkeConfigId,
     PartialBatchSelector,
+    PrepareContinue,
+    PrepareError,
     PrepareInit,
+    PrepareResp,
+    PrepareStepResult,
     ReportId,
+    ReportIdChecksum,
     ReportMetadata,
     ReportShare,
     Time,
@@ -86,3 +95,124 @@ def test_native_scan_is_faster_at_scale():
     # not a strict benchmark — just guard against the fast path regressing
     # to slower-than-Python
     assert fast < slow * 1.5, (fast, slow)
+
+
+def _continue_req(n: int) -> AggregationJobContinueReq:
+    return AggregationJobContinueReq(
+        AggregationJobStep(1),
+        tuple(
+            PrepareContinue(ReportId(os.urandom(16)), os.urandom(20 + i % 9))
+            for i in range(n)))
+
+
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_native_continue_decode_matches_python():
+    req = _continue_req(40)
+    body = req.encode()
+    fast = AggregationJobContinueReq.decode(body)
+    assert fast == req
+
+    import janus_tpu.native as native_mod
+
+    saved = native_mod.available
+    native_mod.available = lambda: False
+    try:
+        slow = AggregationJobContinueReq.decode(body)
+    finally:
+        native_mod.available = saved
+    assert slow == fast
+
+
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_native_continue_rejects_malformed():
+    from janus_tpu.messages.codec import DecodeError
+
+    body = _continue_req(3).encode()
+    with pytest.raises(DecodeError):
+        AggregationJobContinueReq.decode(body[:-1])
+    # corrupt an inner msg_len while keeping the outer vector length intact,
+    # so the C++ scanner (not the outer opaque32 read) must reject it:
+    # body = step u16 || u32 veclen || id[16] || u32 msglen || ...
+    bad = bytearray(body)
+    bad[2 + 4 + 16 + 3] += 1  # first element's msg_len low byte
+    with pytest.raises(DecodeError):
+        AggregationJobContinueReq.decode(bytes(bad))
+
+
+def _resp(n: int) -> AggregationJobResp:
+    resps = []
+    for i in range(n):
+        rid = ReportId(os.urandom(16))
+        if i % 3 == 0:
+            result = PrepareStepResult.continued(os.urandom(17 + i % 5))
+        elif i % 3 == 1:
+            result = PrepareStepResult(PrepareStepResult.FINISHED)
+        else:
+            result = PrepareStepResult.rejected(
+                PrepareError(i % len(PrepareError)))
+        resps.append(PrepareResp(rid, result))
+    return AggregationJobResp(tuple(resps))
+
+
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_native_resp_encode_matches_python():
+    resp = _resp(60)
+    fast = resp.encode()
+
+    import janus_tpu.native as native_mod
+
+    saved = native_mod.available
+    native_mod.available = lambda: False
+    try:
+        slow = resp.encode()
+    finally:
+        native_mod.available = saved
+    assert fast == slow
+    assert AggregationJobResp.decode(fast) == resp
+
+
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_native_resp_decode_matches_python():
+    resp = _resp(45)
+    body = resp.encode()
+    fast = AggregationJobResp.decode(body)
+    assert fast == resp
+
+    import janus_tpu.native as native_mod
+
+    saved = native_mod.available
+    native_mod.available = lambda: False
+    try:
+        slow = AggregationJobResp.decode(body)
+    finally:
+        native_mod.available = saved
+    assert slow == fast
+
+    from janus_tpu.messages.codec import DecodeError
+
+    with pytest.raises(DecodeError):
+        AggregationJobResp.decode(body[:-1])
+    # unknown result kind inside the vector
+    bad = bytearray(AggregationJobResp(
+        (PrepareResp(ReportId(os.urandom(16)),
+                     PrepareStepResult(PrepareStepResult.FINISHED)),)).encode())
+    bad[4 + 16] = 9
+    with pytest.raises(DecodeError):
+        AggregationJobResp.decode(bytes(bad))
+
+
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_native_checksum_matches_python():
+    ids = [ReportId(os.urandom(16)) for _ in range(37)]
+    expect = ReportIdChecksum.zero()
+    for rid in ids:
+        expect = expect.updated_with(rid)
+    got = native.checksum_report_ids(b"".join(bytes(r) for r in ids))
+    assert got == bytes(expect)
+    # continuing a fold from an existing checksum
+    head, tail = ids[:10], ids[10:]
+    mid = native.checksum_report_ids(b"".join(bytes(r) for r in head))
+    got2 = native.checksum_report_ids(
+        b"".join(bytes(r) for r in tail), seed=mid)
+    assert got2 == bytes(expect)
+    assert native.checksum_report_ids(b"") == bytes(32)
