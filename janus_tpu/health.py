@@ -1,21 +1,101 @@
-"""Health-check + metrics HTTP listener (reference config.rs:31
-health_check_listen_address, docs/DEPLOYING.md:61-68; Prometheus exposition
-per metrics.rs).
+"""Health-check + metrics + runtime-console HTTP listener (reference
+config.rs:31 health_check_listen_address, docs/DEPLOYING.md:61-68;
+Prometheus exposition per metrics.rs; /debug/state is the analog of the
+reference's feature-gated tokio-console runtime introspection,
+trace.rs:66).
 
-    GET /healthz  -> 200 "ok"
-    GET /metrics  -> Prometheus text format
+    GET /healthz      -> 200 "ok"
+    GET /metrics      -> Prometheus text format
+    GET /debug/state  -> JSON: threads (name/state/stack top), device
+                         engines (fallbacks, cumulative time split,
+                         compiled-kernel count), process stats
 """
 
 from __future__ import annotations
 
+import json
+import sys
 import threading
+import time
+import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from janus_tpu.metrics import REGISTRY
 
+_START = time.time()
+
+# Engines register here (weakly) so /debug/state can report device activity.
+# WeakSet is not thread-safe; every access holds _engines_lock (registration
+# happens on worker threads while handler threads snapshot).
+import weakref
+
+_engines: "weakref.WeakSet" = weakref.WeakSet()
+_engines_lock = threading.Lock()
+
+
+def register_engine(engine) -> None:
+    """Called by the prep-engine cache; exposes engine state on /debug."""
+    with _engines_lock:
+        _engines.add(engine)
+
+
+def _debug_state() -> dict:
+    frames = sys._current_frames()
+    threads = []
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        top = None
+        if frame is not None:
+            fs = traceback.extract_stack(frame, limit=1)
+            if fs:
+                top = f"{fs[0].filename.rsplit('/', 1)[-1]}:{fs[0].lineno} {fs[0].name}"
+        threads.append({"name": t.name, "daemon": t.daemon, "alive": t.is_alive(),
+                        "top": top})
+    engines = []
+    with _engines_lock:
+        snapshot = list(_engines)
+    for e in snapshot:
+        try:
+            tm = dict(getattr(e, "timings", {}) or {})
+            engines.append({
+                "vdaf": type(getattr(e, "vdaf", None)).__name__,
+                "device": bool(getattr(e, "device_ok", False)),
+                "host_fallbacks": int(getattr(e, "fallback_count", 0)),
+                "compiled_kernels": (
+                    len(getattr(e, "_helper_fns", {}))
+                    + len(getattr(e, "_leader_fns", {}))
+                    + len(getattr(e, "_fns", {}))),
+                "cumulative_seconds": {
+                    k: round(float(v), 3)
+                    for k, v in tm.items() if k != "batches"},
+                "batches": int(tm.get("batches", 0)),
+            })
+        except Exception:  # engine mid-teardown; skip
+            continue
+    return {
+        "uptime_s": round(time.time() - _START, 1),
+        "thread_count": threading.active_count(),
+        "threads": threads,
+        "engines": engines,
+    }
+
+
+def _debug_console_enabled() -> bool:
+    """The runtime console is opt-in (reference gates tokio-console behind a
+    feature flag, trace.rs:66): it exposes thread stacks and engine
+    internals, and health listeners are routinely bound non-loopback for
+    k8s probes."""
+    import os
+
+    return os.environ.get("JANUS_DEBUG_CONSOLE", "") not in ("", "0", "false")
+
 
 class HealthServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 debug_console: bool | None = None):
+        if debug_console is None:
+            debug_console = _debug_console_enabled()
+
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
@@ -23,18 +103,27 @@ class HealthServer:
                 pass
 
             def do_GET(self):
+                status = 200
                 if self.path == "/healthz":
                     body = b"ok"
                     ctype = "text/plain"
                 elif self.path == "/metrics":
                     body = REGISTRY.exposition().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path == "/debug/state" and debug_console:
+                    try:
+                        body = json.dumps(_debug_state(), indent=1).encode()
+                        ctype = "application/json"
+                    except Exception as e:  # introspection must not 500 the
+                        status = 500        # probe port with a dropped conn
+                        body = f"debug state unavailable: {e}".encode()
+                        ctype = "text/plain"
                 else:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -954,7 +954,25 @@ class AggregationJobContinueReq(WireMessage):
     prepare_continues: tuple[PrepareContinue, ...]
 
     def encode(self) -> bytes:
-        return self.step.encode() + encode_vec32(self.prepare_continues)
+        body = self._encode_continues_native()
+        if body is None:
+            body = encode_vec32(self.prepare_continues)
+        return self.step.encode() + body
+
+    def _encode_continues_native(self) -> bytes | None:
+        """Fast path: the PrepareContinue vector body in one C++ pass
+        (janus_tpu.native.build_prepare_continues); None -> Python codec."""
+        from janus_tpu import native
+
+        if not native.available() or not self.prepare_continues:
+            return None
+        n = len(self.prepare_continues)
+        ids = bytearray(n * 16)
+        messages = []
+        for k, pc in enumerate(self.prepare_continues):
+            ids[k * 16 : (k + 1) * 16] = bytes(pc.report_id)
+            messages.append(pc.message)
+        return native.build_prepare_continues(bytes(ids), messages)
 
     @classmethod
     def decode_from(cls, cur: Cursor) -> "AggregationJobContinueReq":

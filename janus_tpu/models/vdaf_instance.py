@@ -213,6 +213,9 @@ def prep_engine(inst: VdafInstance):
 
                 engine = HostPrepEngine(vdaf)
             _engines[inst] = engine
+            from janus_tpu.health import register_engine
+
+            register_engine(engine)
         return engine
 
 

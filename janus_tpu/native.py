@@ -83,6 +83,10 @@ def _load():
             lib.build_prepare_resps.argtypes = [
                 ctypes.c_long, ctypes.c_char_p, u8p, u8p, ctypes.c_char_p,
                 ctypes.POINTER(ctypes.c_int64), u8p, ctypes.c_long]
+            lib.build_prepare_continues.restype = ctypes.c_long
+            lib.build_prepare_continues.argtypes = [
+                ctypes.c_long, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64), u8p, ctypes.c_long]
             lib.checksum_report_ids.restype = None
             lib.checksum_report_ids.argtypes = [ctypes.c_char_p,
                                                 ctypes.c_long, u8p]
@@ -179,6 +183,28 @@ def build_prepare_resps(ids: bytes, kinds, errors, messages: list[bytes]):
         n, ids, kinds.ctypes.data_as(u8p), errors.ctypes.data_as(u8p),
         msgs, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         out.ctypes.data_as(u8p), cap)
+    if wrote < 0:
+        return None
+    return out[:wrote].tobytes()
+
+
+def build_prepare_continues(ids: bytes, messages: list[bytes]):
+    """Emit an encoded PrepareContinue vector body (u32 length prefix
+    included) in one native pass, or None when the toolchain is missing.
+
+    ids: n x 16 contiguous report ids; messages: one payload per lane."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(messages)
+    msgs = b"".join(messages)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(m) for m in messages], out=offs[1:])
+    cap = 4 + n * 20 + len(msgs)
+    out = np.empty(cap, dtype=np.uint8)
+    wrote = lib.build_prepare_continues(
+        n, ids, msgs, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
     if wrote < 0:
         return None
     return out[:wrote].tobytes()

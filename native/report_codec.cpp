@@ -119,6 +119,30 @@ static inline void wr32(uint8_t* p, uint32_t v) {
     p[2] = uint8_t(v >> 8);  p[3] = uint8_t(v);
 }
 
+// One-pass PrepareContinue vector body builder (leader -> helper continue
+// direction; layout messages lib.rs:2373,2614).  Same input convention as
+// build_prepare_resps: `ids` n x 16, `msgs` concatenated payloads with
+// prefix offsets `msg_offs` int64[n+1].  Writes u32 total length || entries
+// (entry = id[16] || opaque32 message); returns bytes written or -1.
+long build_prepare_continues(long n, const uint8_t* ids, const uint8_t* msgs,
+                             const int64_t* msg_offs, uint8_t* out,
+                             long out_cap) {
+    long off = 4;
+    for (long k = 0; k < n; ++k) {
+        int64_t m0 = msg_offs[k], m1 = msg_offs[k + 1];
+        int64_t mlen = m1 - m0;
+        if (mlen < 0 || off + 16 + 4 + mlen > out_cap) return -1;
+        for (int i = 0; i < 16; ++i) out[off + i] = ids[k * 16 + i];
+        off += 16;
+        wr32(out + off, (uint32_t)mlen);
+        off += 4;
+        for (int64_t i = 0; i < mlen; ++i) out[off + i] = msgs[m0 + i];
+        off += mlen;
+    }
+    wr32(out, (uint32_t)(off - 4));
+    return off;
+}
+
 // One-pass AggregationJobResp body builder (messages lib.rs:2237,2283,2669):
 //   encode_vec32(PrepareResp) where
 //   PrepareResp       = report_id[16] || PrepareStepResult

@@ -1,0 +1,163 @@
+"""Property tests for the lease / transaction layer (SURVEY.md §5.2).
+
+The reference gets its concurrency safety from Rust ownership plus the
+single-writer lease discipline (datastore.rs:1755-1828) and idempotent
+transaction closures re-run on serialization failure (datastore.rs:232-283).
+Here those guarantees are checked as explicit properties over randomized
+interleavings:
+
+  P1  no two live leases ever cover the same job, under any interleaving of
+      acquire / release / clock advance;
+  P2  a stale lease token (expired and re-acquired by someone else) can
+      neither release nor (via release) disturb the current holder;
+  P3  lease_attempts counts every successful acquisition, monotonically;
+  P4  a run_tx closure that hits serialization conflicts is re-run until it
+      commits exactly once (idempotent-closure discipline).
+"""
+
+import random
+import threading
+
+from janus_tpu.aggregator.aggregation_job_creator import AggregationJobCreator
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.datastore import (
+    SerializationConflict,
+    ephemeral_datastore,
+)
+from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+from janus_tpu.messages import Duration, Time
+from janus_tpu.models import VdafInstance
+
+
+def _ds_with_jobs(n_jobs: int):
+    builder = TaskBuilder(QueryTypeCfg.time_interval(), VdafInstance.fake())
+    clock = MockClock(Time(1_700_000_000))
+    ds = ephemeral_datastore(clock)
+    task = builder.leader_view()
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(task))
+    from janus_tpu.datastore.models import LeaderStoredReport
+    from janus_tpu.messages import (
+        HpkeCiphertext,
+        HpkeConfigId,
+        ReportId,
+        ReportMetadata,
+    )
+
+    def put(tx):
+        for i in range(2 * n_jobs):
+            tx.put_client_report(LeaderStoredReport(
+                task_id=task.task_id,
+                metadata=ReportMetadata(ReportId(i.to_bytes(16, "big")),
+                                        clock.now()),
+                public_share=b"",
+                leader_extensions=(),
+                leader_input_share=bytes([i % 250]),
+                helper_encrypted_input_share=HpkeCiphertext(
+                    HpkeConfigId(1), b"enc", b"ct"),
+            ))
+
+    ds.run_tx("r", put)
+    made = AggregationJobCreator(
+        ds, 1, 2, batch_aggregation_shard_count=2).run_once()
+    assert made == n_jobs
+    return ds, clock, task
+
+
+def test_p1_no_double_claim_under_random_interleavings():
+    rng = random.Random(0xC0FFEE)
+    ds, clock, _task = _ds_with_jobs(6)
+    lease_duration = Duration(100)
+    held: dict[bytes, object] = {}  # job id -> live lease (test's view)
+
+    for _step in range(120):
+        op = rng.random()
+        if op < 0.5:
+            leases = ds.run_tx(
+                "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                    lease_duration, rng.randint(1, 4)))
+            for lease in leases:
+                jid = bytes(lease.leased.aggregation_job_id)
+                # P1: anything we still consider held must NOT be re-leased
+                # unless its lease had expired
+                if jid in held:
+                    expired = held[jid].lease_expiry.seconds <= clock.now().seconds
+                    assert expired, (
+                        f"job {jid.hex()} leased twice while live")
+                held[jid] = lease
+        elif op < 0.8 and held:
+            jid = rng.choice(sorted(held))
+            lease = held.pop(jid)
+            ds.run_tx("rel",
+                      lambda tx: tx.release_aggregation_job(lease))
+        else:
+            # expired entries stay in `held` on purpose: P1's assertion
+            # allows a re-claim only when the prior lease had expired
+            clock.advance(Duration(rng.randint(1, 60)))
+
+
+def test_p2_stale_token_cannot_disturb_current_holder():
+    ds, clock, _task = _ds_with_jobs(1)
+    first = ds.run_tx(
+        "a1", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+            Duration(50), 1))
+    assert len(first) == 1
+    stale = first[0]
+
+    clock.advance(Duration(51))  # stale expires
+    second = ds.run_tx(
+        "a2", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+            Duration(500), 1))
+    assert len(second) == 1
+    assert second[0].lease_token != stale.lease_token
+
+    # the crashed-and-recovered worker tries to release with its old token:
+    # the UPDATE is guarded by lease_token (reference datastore.rs:1828 +
+    # check_single_row_mutation) and the mismatch surfaces loudly
+    import pytest
+
+    from janus_tpu.datastore.datastore import MutationTargetNotFound
+
+    with pytest.raises(MutationTargetNotFound):
+        ds.run_tx("rel-stale", lambda tx: tx.release_aggregation_job(stale))
+    third = ds.run_tx(
+        "a3", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+            Duration(500), 1))
+    assert third == [], "stale release freed a job another worker holds"
+
+
+def test_p3_lease_attempts_count_every_acquisition():
+    ds, clock, _task = _ds_with_jobs(1)
+    for expected_attempts in (1, 2, 3, 4):
+        leases = ds.run_tx(
+            "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(10), 1))
+        assert len(leases) == 1
+        assert leases[0].lease_attempts == expected_attempts
+        clock.advance(Duration(11))
+
+
+def test_p4_run_tx_retries_conflicting_closure_to_one_commit():
+    ds, clock, task = _ds_with_jobs(1)
+    calls = {"n": 0}
+
+    def closure(tx):
+        calls["n"] += 1
+        # the closure runs its writes every attempt (idempotent by design:
+        # re-running replaces, not duplicates)
+        leases = tx.acquire_incomplete_aggregation_jobs(Duration(60), 1)
+        if calls["n"] < 3:
+            raise SerializationConflict("injected")
+        return leases
+
+    leases = ds.run_tx("conflicted", closure)
+    assert calls["n"] == 3, "closure must re-run until it commits"
+    assert len(leases) == 1
+    # only the COMMITTED attempt's effects persist: attempts counts the
+    # rolled-back tries zero times plus the committed one
+    assert leases[0].lease_attempts == 1
+
+    # and nothing further is acquirable (single live lease)
+    again = ds.run_tx(
+        "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(
+            Duration(60), 1))
+    assert again == []

@@ -37,3 +37,35 @@ def test_health_server_serves_metrics():
         assert requests.get(f"{server.address}/nope", timeout=5).status_code == 404
     finally:
         server.stop()
+
+
+def test_debug_state_reports_threads_and_engines():
+    """/debug/state — the runtime-console analog (reference trace.rs:66
+    tokio-console): thread stacks + device-engine activity."""
+    from janus_tpu.models import VdafInstance
+    from janus_tpu.models.vdaf_instance import prep_engine
+
+    engine = prep_engine(VdafInstance.prio3_count())
+    # off by default (opt-in like the reference's tokio-console feature)
+    plain = HealthServer().start()
+    try:
+        assert requests.get(f"{plain.address}/debug/state",
+                            timeout=5).status_code == 404
+    finally:
+        plain.stop()
+
+    server = HealthServer(debug_console=True).start()
+    try:
+        r = requests.get(f"{server.address}/debug/state", timeout=5)
+        assert r.status_code == 200
+        state = r.json()
+        assert state["thread_count"] >= 1
+        assert any(t["name"] == "MainThread" for t in state["threads"])
+        # at least one registered engine, with the console fields present
+        names = [e["vdaf"] for e in state["engines"]]
+        assert "Prio3" in names, names
+        e = state["engines"][names.index("Prio3")]
+        assert e["host_fallbacks"] == engine.fallback_count
+        assert "compiled_kernels" in e and "batches" in e
+    finally:
+        server.stop()

@@ -118,9 +118,17 @@ def test_native_continue_decode_matches_python():
     native_mod.available = lambda: False
     try:
         slow = AggregationJobContinueReq.decode(body)
+        body_py = req.encode()
     finally:
         native_mod.available = saved
     assert slow == fast
+    # native and Python encoders emit identical bytes
+    assert body == body_py
+    # zero-length message lanes survive the builder
+    zreq = AggregationJobContinueReq(
+        AggregationJobStep(1),
+        (PrepareContinue(ReportId(os.urandom(16)), b""),))
+    assert AggregationJobContinueReq.decode(zreq.encode()) == zreq
 
 
 @pytest.mark.skipif(not native.available(), reason="no native toolchain")
