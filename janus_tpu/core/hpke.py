@@ -261,6 +261,40 @@ def open_ciphertext(keypair: "HpkeKeypair", application_info: bytes,
         raise HpkeError("HPKE open failed") from e
 
 
+def open_ciphertexts_batch(keypair: "HpkeKeypair", application_info: bytes,
+                           ciphertexts: list[HpkeCiphertext],
+                           aads: list[bytes]) -> list[bytes | None]:
+    """Open many ciphertexts under one keypair/info: one GIL-free native
+    pass for the DAP-default suites (native/hpke_open.cpp), the per-report
+    Python path otherwise.  Per-lane results: plaintext or None (failed) —
+    a failed lane never aborts the batch (the caller maps None to
+    PrepareError::HpkeDecryptError, reference aggregator.rs:1800)."""
+    config = keypair.config
+    if not is_hpke_config_supported(config):
+        raise HpkeError("unsupported HPKE configuration")
+    native_ok = (
+        config.kem_id.code == HpkeKemId.X25519_HKDF_SHA256.code
+        and config.kdf_id.code == HpkeKdfId.HKDF_SHA256.code
+    )
+    if native_ok and len(ciphertexts) > 1:
+        from janus_tpu import native
+
+        res = native.hpke_open_batch(
+            keypair.private_key, config.public_key.data,
+            config.aead_id.code, application_info,
+            [ct.encapsulated_key for ct in ciphertexts],
+            [ct.payload for ct in ciphertexts], aads)
+        if res is not None:
+            return res
+    out: list[bytes | None] = []
+    for ct, aad in zip(ciphertexts, aads):
+        try:
+            out.append(open_ciphertext(keypair, application_info, ct, aad))
+        except HpkeError:
+            out.append(None)
+    return out
+
+
 @dataclass(frozen=True)
 class HpkeKeypair:
     """An HPKE config plus its private key (reference hpke.rs:240)."""

@@ -1,12 +1,19 @@
 """Native (C++) runtime helpers, compiled on demand and loaded via ctypes.
 
-The reference's runtime is fully native; here the hot host-side wire
-parsing gets the same treatment: `parse_prepare_inits` scans an
-AggregationJobInitializeReq's PrepareInit vector in one C++ pass and hands
-Python an offset table (native/report_codec.cpp).  The build is a single
-g++ -O2 -shared invocation cached under ~/.cache/janus_tpu_native keyed by
-source hash; everything degrades gracefully to the pure-Python codec when a
-toolchain is unavailable.
+The reference's runtime is fully native; here the hot host-side work gets
+the same treatment, as two independently-loaded modules:
+
+- `report_codec` (native/report_codec.cpp, dependency-free): one-pass wire
+  scanners for the PrepareInit/Continue/Resp vectors, the
+  AggregationJobResp/ContinueReq body builders, and the SHA-256 XOR
+  report-id checksum fold.
+- `hpke_open` (native/hpke_open.cpp, links libcrypto): batched RFC 9180
+  base-mode HPKE open for the DAP-default suites, GIL-free per batch.
+
+Each builds with a single g++ -O2 -shared invocation cached under
+~/.cache/janus_tpu_native keyed by source hash; everything degrades
+gracefully to the pure-Python paths when a toolchain (or libcrypto) is
+unavailable.
 """
 
 from __future__ import annotations
@@ -19,16 +26,19 @@ import threading
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                    "native", "report_codec.cpp")
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_hpke_lib = None
+_hpke_tried = False
 
 
-def _build() -> str | None:
+def _build(src_name: str, link_flags: tuple[str, ...] = ()) -> str | None:
+    src_path = os.path.join(_NATIVE_DIR, f"{src_name}.cpp")
     try:
-        with open(_SRC, "rb") as f:
+        with open(src_path, "rb") as f:
             src = f.read()
     except OSError:
         return None
@@ -36,14 +46,15 @@ def _build() -> str | None:
     cache_dir = os.environ.get(
         "JANUS_TPU_NATIVE_CACHE",
         os.path.expanduser("~/.cache/janus_tpu_native"))
-    out = os.path.join(cache_dir, f"report_codec_{digest}.so")
+    out = os.path.join(cache_dir, f"{src_name}_{digest}.so")
     if os.path.exists(out):
         return out
     os.makedirs(cache_dir, exist_ok=True)
     tmp = out + f".tmp{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src_path,
+             *link_flags],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
         return out
@@ -61,7 +72,7 @@ def _load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        path = _build()
+        path = _build("report_codec")
         if path is None:
             return None
         try:
@@ -208,6 +219,94 @@ def build_prepare_continues(ids: bytes, messages: list[bytes]):
     if wrote < 0:
         return None
     return out[:wrote].tobytes()
+
+
+def _load_hpke():
+    global _hpke_lib, _hpke_tried
+    with _lock:
+        if _hpke_lib is not None or _hpke_tried:
+            return _hpke_lib
+        _hpke_tried = True
+        # no OpenSSL -dev package in the runtime image: link the versioned
+        # .so directly when the plain -lcrypto symlink is absent
+        import ctypes.util
+
+        soname = ctypes.util.find_library("crypto") or "libcrypto.so.3"
+        link: tuple[str, ...] = ("-lcrypto",)
+        for d in ("/lib/x86_64-linux-gnu", "/usr/lib/x86_64-linux-gnu",
+                  "/usr/lib", "/lib"):
+            cand = os.path.join(d, soname)
+            if os.path.exists(cand):
+                link = (cand,)
+                break
+        path = _build("hpke_open", link_flags=link)
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.hpke_open_batch.restype = ctypes.c_long
+            lib.hpke_open_batch.argtypes = [
+                ctypes.c_long, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_long,
+                ctypes.c_char_p, ctypes.c_char_p, i64p, ctypes.c_char_p,
+                i64p, u8p, i64p, u8p]
+            _hpke_lib = lib
+        except OSError:
+            _hpke_lib = None
+        return _hpke_lib
+
+
+def hpke_available() -> bool:
+    return _load_hpke() is not None
+
+
+def hpke_open_batch(sk_r: bytes, pk_r: bytes, aead_id: int, info: bytes,
+                    encs: list[bytes], cts: list[bytes], aads: list[bytes]):
+    """Open n base-mode HPKE ciphertexts (DHKEM X25519 + HKDF-SHA256) in one
+    GIL-free native pass.  Returns a list of (plaintext | None) per lane —
+    None = that lane failed to open — or None when the native module is
+    unavailable (caller uses the Python path).
+
+    aead_id: 1=AES-128-GCM, 2=AES-256-GCM, 3=ChaCha20-Poly1305."""
+    lib = _load_hpke()
+    if lib is None:
+        return None
+    n = len(encs)
+    if n == 0:
+        return []
+    if len(sk_r) != 32 or len(pk_r) != 32:
+        raise ValueError("X25519 keys must be 32 bytes")
+    if any(len(e) != 32 for e in encs):
+        # malformed encapsulated key: that lane can never open; do them all
+        # natively anyway by zero-padding (x25519 of a wrong-size key is a
+        # decode failure, which the scanner upstream normally rejects)
+        encs = [e if len(e) == 32 else bytes(32) for e in encs]
+    enc_blob = b"".join(encs)
+    ct_blob = b"".join(cts)
+    aad_blob = b"".join(aads)
+    ct_offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(c) for c in cts], out=ct_offs[1:])
+    aad_offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(a) for a in aads], out=aad_offs[1:])
+    out = np.empty(max(1, len(ct_blob)), dtype=np.uint8)
+    out_offs = np.zeros(n + 1, dtype=np.int64)
+    status = np.zeros(n, dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    wrote = lib.hpke_open_batch(
+        n, sk_r, pk_r, aead_id, info, len(info), enc_blob, ct_blob,
+        ct_offs.ctypes.data_as(i64p), aad_blob,
+        aad_offs.ctypes.data_as(i64p), out.ctypes.data_as(u8p),
+        out_offs.ctypes.data_as(i64p), status.ctypes.data_as(u8p))
+    if wrote < 0:
+        return None
+    blob = out.tobytes()
+    return [
+        blob[out_offs[i]:out_offs[i + 1]] if status[i] else None
+        for i in range(n)
+    ]
 
 
 def checksum_report_ids(ids: bytes, seed: bytes = bytes(32)):

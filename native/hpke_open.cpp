@@ -1,0 +1,261 @@
+// Batched HPKE open (RFC 9180 base mode) over libcrypto.
+//
+// The helper aggregate-init hot path opens one HPKE ciphertext per report
+// (reference aggregator.rs:1772-1832 via core/src/hpke.rs:192).  The Python
+// plane (janus_tpu/core/hpke.py) pays interpreter overhead per report and
+// holds the GIL; this batch entry point opens N ciphertexts per call with
+// the GIL released (ctypes releases it for the duration), using OpenSSL's
+// EVP primitives for X25519, HMAC-SHA256 (HKDF), and the AEADs.
+//
+// Scope: DHKEM(X25519, HKDF-SHA256) + HKDF-SHA256 + {AES-128-GCM,
+// AES-256-GCM, ChaCha20-Poly1305} — the DAP-default cipher suites.  Other
+// suites stay on the Python path (janus_tpu/native.py gates on suite ids).
+//
+// Per-lane failure semantics: status[i]=1 on success, 0 on any failure
+// (bad point, AEAD tag mismatch) — the caller maps 0 lanes to per-report
+// PrepareError::HpkeDecryptError, never a batch abort.
+
+#include <cstdint>
+#include <cstring>
+
+// The image ships libcrypto.so.3 but not the OpenSSL headers, so the small
+// EVP surface used here is declared manually (stable public ABI; the build
+// links the versioned .so directly — see janus_tpu/native.py).
+extern "C" {
+typedef struct evp_pkey_st EVP_PKEY;
+typedef struct evp_pkey_ctx_st EVP_PKEY_CTX;
+typedef struct evp_cipher_st EVP_CIPHER;
+typedef struct evp_cipher_ctx_st EVP_CIPHER_CTX;
+typedef struct evp_md_st EVP_MD;
+typedef struct engine_st ENGINE;
+
+EVP_PKEY* EVP_PKEY_new_raw_private_key(int type, ENGINE* e,
+                                       const unsigned char* priv, size_t len);
+EVP_PKEY* EVP_PKEY_new_raw_public_key(int type, ENGINE* e,
+                                      const unsigned char* pub, size_t len);
+void EVP_PKEY_free(EVP_PKEY* pkey);
+EVP_PKEY_CTX* EVP_PKEY_CTX_new(EVP_PKEY* pkey, ENGINE* e);
+void EVP_PKEY_CTX_free(EVP_PKEY_CTX* ctx);
+int EVP_PKEY_derive_init(EVP_PKEY_CTX* ctx);
+int EVP_PKEY_derive_set_peer(EVP_PKEY_CTX* ctx, EVP_PKEY* peer);
+int EVP_PKEY_derive(EVP_PKEY_CTX* ctx, unsigned char* key, size_t* keylen);
+
+const EVP_MD* EVP_sha256(void);
+unsigned char* HMAC(const EVP_MD* evp_md, const void* key, int key_len,
+                    const unsigned char* data, size_t data_len,
+                    unsigned char* md, unsigned int* md_len);
+
+EVP_CIPHER_CTX* EVP_CIPHER_CTX_new(void);
+void EVP_CIPHER_CTX_free(EVP_CIPHER_CTX* ctx);
+const EVP_CIPHER* EVP_aes_128_gcm(void);
+const EVP_CIPHER* EVP_aes_256_gcm(void);
+const EVP_CIPHER* EVP_chacha20_poly1305(void);
+int EVP_DecryptInit_ex(EVP_CIPHER_CTX* ctx, const EVP_CIPHER* cipher,
+                       ENGINE* impl, const unsigned char* key,
+                       const unsigned char* iv);
+int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX* ctx, int type, int arg, void* ptr);
+int EVP_DecryptUpdate(EVP_CIPHER_CTX* ctx, unsigned char* out, int* outl,
+                      const unsigned char* in, int inl);
+int EVP_DecryptFinal_ex(EVP_CIPHER_CTX* ctx, unsigned char* outm, int* outl);
+}  // extern "C" (libcrypto declarations)
+
+// OpenSSL public constants (stable across 1.1/3.x)
+static const int EVP_PKEY_X25519_ID = 1034;        // NID_X25519
+static const int EVP_CTRL_AEAD_SET_IVLEN_ID = 0x9;
+static const int EVP_CTRL_AEAD_SET_TAG_ID = 0x11;
+
+extern "C" {
+
+static const uint8_t HPKE_V1[7] = {'H', 'P', 'K', 'E', '-', 'v', '1'};
+
+// HMAC-SHA256(salt, msg) -> 32 bytes
+static bool hmac256(const uint8_t* key, size_t key_len, const uint8_t* msg,
+                    size_t msg_len, uint8_t* out) {
+    unsigned int out_len = 32;
+    return HMAC(EVP_sha256(), key, (int)key_len, msg, msg_len, out, &out_len)
+           != nullptr && out_len == 32;
+}
+
+// LabeledExtract(salt, label, ikm) with suite prefix
+static bool labeled_extract(const uint8_t* salt, size_t salt_len,
+                            const uint8_t* suite, size_t suite_len,
+                            const char* label, const uint8_t* ikm,
+                            size_t ikm_len, uint8_t* out) {
+    uint8_t zeros[32] = {0};
+    if (salt_len == 0) { salt = zeros; salt_len = 32; }
+    uint8_t msg[512];
+    size_t off = 0;
+    size_t label_len = strlen(label);
+    if (7 + suite_len + label_len + ikm_len > sizeof(msg)) return false;
+    memcpy(msg + off, HPKE_V1, 7); off += 7;
+    memcpy(msg + off, suite, suite_len); off += suite_len;
+    memcpy(msg + off, label, label_len); off += label_len;
+    memcpy(msg + off, ikm, ikm_len); off += ikm_len;
+    return hmac256(salt, salt_len, msg, off, out);
+}
+
+// LabeledExpand(prk, label, info, L): HKDF-Expand with prefixed info.
+// L <= 32 here (keys/nonces), so a single HMAC block suffices.
+static bool labeled_expand(const uint8_t* prk, const uint8_t* suite,
+                           size_t suite_len, const char* label,
+                           const uint8_t* info, size_t info_len, size_t L,
+                           uint8_t* out) {
+    uint8_t msg[512];
+    size_t off = 0;
+    size_t label_len = strlen(label);
+    if (2 + 7 + suite_len + label_len + info_len + 1 > sizeof(msg))
+        return false;
+    msg[off++] = (uint8_t)(L >> 8);
+    msg[off++] = (uint8_t)L;
+    memcpy(msg + off, HPKE_V1, 7); off += 7;
+    memcpy(msg + off, suite, suite_len); off += suite_len;
+    memcpy(msg + off, label, label_len); off += label_len;
+    memcpy(msg + off, info, info_len); off += info_len;
+    msg[off++] = 1;  // T(1) counter
+    uint8_t t[32];
+    if (!hmac256(prk, 32, msg, off, t)) return false;
+    memcpy(out, t, L);
+    return true;
+}
+
+// X25519 with the recipient private key hoisted out of the batch loop
+// (EVP_PKEY parse/alloc per lane costs as much as the scalar mult).
+static bool x25519_with(EVP_PKEY* priv, const uint8_t* pk, uint8_t* dh) {
+    bool ok = false;
+    EVP_PKEY* peer = EVP_PKEY_new_raw_public_key(EVP_PKEY_X25519_ID, nullptr,
+                                                 pk, 32);
+    EVP_PKEY_CTX* ctx = priv ? EVP_PKEY_CTX_new(priv, nullptr) : nullptr;
+    size_t len = 32;
+    if (priv && peer && ctx
+        && EVP_PKEY_derive_init(ctx) == 1
+        && EVP_PKEY_derive_set_peer(ctx, peer) == 1
+        && EVP_PKEY_derive(ctx, dh, &len) == 1 && len == 32)
+        ok = true;
+    if (ctx) EVP_PKEY_CTX_free(ctx);
+    if (peer) EVP_PKEY_free(peer);
+    // RFC 7748: all-zero shared secret (small-order point) must be rejected
+    if (ok) {
+        uint8_t acc = 0;
+        for (int i = 0; i < 32; ++i) acc |= dh[i];
+        ok = acc != 0;
+    }
+    return ok;
+}
+
+// AEAD open; aead_id per HpkeAeadId: 1=AES-128-GCM, 2=AES-256-GCM,
+// 3=ChaCha20-Poly1305.  ct includes the 16-byte tag at the end.
+static bool aead_open(int aead_id, const uint8_t* key, const uint8_t* nonce,
+                      const uint8_t* aad, size_t aad_len, const uint8_t* ct,
+                      size_t ct_len, uint8_t* out, size_t* out_len) {
+    if (ct_len < 16) return false;
+    const EVP_CIPHER* cipher =
+        aead_id == 1 ? EVP_aes_128_gcm()
+        : aead_id == 2 ? EVP_aes_256_gcm()
+        : aead_id == 3 ? EVP_chacha20_poly1305()
+                       : nullptr;
+    if (!cipher) return false;
+    size_t pt_len = ct_len - 16;
+    bool ok = false;
+    EVP_CIPHER_CTX* ctx = EVP_CIPHER_CTX_new();
+    int len = 0;
+    if (ctx
+        && EVP_DecryptInit_ex(ctx, cipher, nullptr, nullptr, nullptr) == 1
+        && EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_AEAD_SET_IVLEN_ID, 12, nullptr) == 1
+        && EVP_DecryptInit_ex(ctx, nullptr, nullptr, key, nonce) == 1
+        && (aad_len == 0
+            || EVP_DecryptUpdate(ctx, nullptr, &len, aad, (int)aad_len) == 1)
+        && EVP_DecryptUpdate(ctx, out, &len, ct, (int)pt_len) == 1) {
+        int total = len;
+        if (EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_AEAD_SET_TAG_ID, 16,
+                                (void*)(ct + pt_len)) == 1
+            && EVP_DecryptFinal_ex(ctx, out + total, &len) == 1) {
+            *out_len = (size_t)(total + len);
+            ok = true;
+        }
+    }
+    if (ctx) EVP_CIPHER_CTX_free(ctx);
+    return ok;
+}
+
+// Batched base-mode HPKE open for DHKEM(X25519, HKDF-SHA256) + HKDF-SHA256.
+//
+//   n:        lanes
+//   sk_r/pk_r: recipient keypair (32 + 32 bytes)
+//   aead_id:  1|2|3 (see aead_open)
+//   info:     application info, shared by the batch
+//   encs:     n x 32 encapsulated keys
+//   cts/ct_offs:   concatenated ciphertexts (tag included) + int64[n+1]
+//   aads/aad_offs: concatenated AADs + int64[n+1]
+//   out:      plaintext arena, capacity >= cts total (pt is 16B shorter)
+//   out_offs: int64[n+1], written (prefix offsets of each plaintext)
+//   status:   u8[n], 1 = opened, 0 = failed
+// Returns total plaintext bytes written, or -1 on invalid arguments.
+long hpke_open_batch(long n, const uint8_t* sk_r, const uint8_t* pk_r,
+                     int aead_id, const uint8_t* info, long info_len,
+                     const uint8_t* encs, const uint8_t* cts,
+                     const int64_t* ct_offs, const uint8_t* aads,
+                     const int64_t* aad_offs, uint8_t* out,
+                     int64_t* out_offs, uint8_t* status) {
+    if (n < 0 || aead_id < 1 || aead_id > 3) return -1;
+    size_t nk = aead_id == 1 ? 16 : 32;
+    // suite ids: KEM 0x0020 (X25519-SHA256); full = KEM||KDF(1)||AEAD
+    const uint8_t kem_suite[5] = {'K', 'E', 'M', 0x00, 0x20};
+    const uint8_t suite[10] = {'H', 'P', 'K', 'E', 0x00, 0x20, 0x00, 0x01,
+                               0x00, (uint8_t)aead_id};
+    int64_t out_off = 0;
+    out_offs[0] = 0;
+    EVP_PKEY* priv = EVP_PKEY_new_raw_private_key(EVP_PKEY_X25519_ID, nullptr,
+                                                  sk_r, 32);
+    for (long i = 0; i < n; ++i) {
+        status[i] = 0;
+        out_offs[i + 1] = out_off;
+        const uint8_t* enc = encs + i * 32;
+        uint8_t dh[32];
+        if (!x25519_with(priv, enc, dh)) continue;
+        // shared_secret = LabeledExpand(LabeledExtract("", "eae_prk", dh),
+        //                               "shared_secret", enc || pk_r, 32)
+        uint8_t eae_prk[32], shared[32];
+        uint8_t kem_context[64];
+        memcpy(kem_context, enc, 32);
+        memcpy(kem_context + 32, pk_r, 32);
+        if (!labeled_extract(nullptr, 0, kem_suite, 5, "eae_prk", dh, 32,
+                             eae_prk)
+            || !labeled_expand(eae_prk, kem_suite, 5, "shared_secret",
+                               kem_context, 64, 32, shared))
+            continue;
+        // key schedule (mode_base)
+        uint8_t psk_id_hash[32], info_hash[32], secret[32];
+        uint8_t context[65];
+        uint8_t key[32], nonce[12];
+        if (!labeled_extract(nullptr, 0, suite, 10, "psk_id_hash", nullptr, 0,
+                             psk_id_hash)
+            || !labeled_extract(nullptr, 0, suite, 10, "info_hash", info,
+                                (size_t)info_len, info_hash))
+            continue;
+        context[0] = 0;  // mode_base
+        memcpy(context + 1, psk_id_hash, 32);
+        memcpy(context + 33, info_hash, 32);
+        if (!labeled_extract(shared, 32, suite, 10, "secret", nullptr, 0,
+                             secret)
+            || !labeled_expand(secret, suite, 10, "key", context, 65, nk, key)
+            || !labeled_expand(secret, suite, 10, "base_nonce", context, 65,
+                               12, nonce))
+            continue;
+        // seq-0 nonce == base nonce; open
+        const uint8_t* ct = cts + ct_offs[i];
+        size_t ct_len = (size_t)(ct_offs[i + 1] - ct_offs[i]);
+        const uint8_t* aad = aads + aad_offs[i];
+        size_t aad_len = (size_t)(aad_offs[i + 1] - aad_offs[i]);
+        size_t pt_len = 0;
+        if (!aead_open(aead_id, key, nonce, aad, aad_len, ct, ct_len,
+                       out + out_off, &pt_len))
+            continue;
+        out_off += (int64_t)pt_len;
+        out_offs[i + 1] = out_off;
+        status[i] = 1;
+    }
+    if (priv) EVP_PKEY_free(priv);
+    return out_off;
+}
+
+}  // extern "C"

@@ -70,3 +70,72 @@ def test_hpke_open_known_answer(config, vector):
     plaintext = hpke.open_ciphertext(keypair, info, ct,
                                      bytes.fromhex(first["aad"]))
     assert plaintext == bytes.fromhex(first["pt"])
+
+
+def test_batch_open_parity_and_per_lane_failures():
+    """open_ciphertexts_batch: native batch (X25519 suites) must match the
+    per-report Python path bit-for-bit, including per-lane failures and the
+    zero-lane/singleton edge cases; non-X25519 KEMs take the Python loop.
+
+    Skipped when the native module is absent — without it this would pass
+    vacuously against the Python loop."""
+    import os
+
+    from janus_tpu import native
+    from janus_tpu.messages import HpkeAeadId, HpkeKemId
+
+    if not native.hpke_available():
+        pytest.skip("no native toolchain / libcrypto")
+
+    for aead in (HpkeAeadId.AES_128_GCM, HpkeAeadId.AES_256_GCM,
+                 HpkeAeadId.CHACHA20_POLY1305):
+        kp = hpke.HpkeKeypair.generate(1, aead_id=aead)
+        info = b"batch parity"
+        pts = [os.urandom(40 + i) for i in range(17)]
+        aads = [os.urandom(5 + i % 3) for i in range(17)]
+        cts = [hpke.seal(kp.config, info, pt, aad)
+               for pt, aad in zip(pts, aads)]
+        assert hpke.open_ciphertexts_batch(kp, info, cts, aads) == pts
+        # tamper two lanes: wrong AAD and truncated payload
+        bad_aads = list(aads)
+        bad_aads[2] = b"wrong"
+        res = hpke.open_ciphertexts_batch(kp, info, cts, bad_aads)
+        assert res[2] is None
+        assert [r for i, r in enumerate(res) if i != 2] == [
+            p for i, p in enumerate(pts) if i != 2]
+        short = list(cts)
+        short[5] = HpkeCiphertext(short[5].config_id,
+                                  short[5].encapsulated_key,
+                                  short[5].payload[:-1])
+        res = hpke.open_ciphertexts_batch(kp, info, short, aads)
+        assert res[5] is None and res[6] == pts[6]
+    assert hpke.open_ciphertexts_batch(kp, info, [], []) == []
+    assert hpke.open_ciphertexts_batch(kp, info, cts[:1], aads[:1]) == pts[:1]
+
+    # P-256 KEM: the python fallback loop, same contract
+    kp = hpke.HpkeKeypair.generate(1, kem_id=HpkeKemId.P256_HKDF_SHA256)
+    cts = [hpke.seal(kp.config, b"i", pt, b"a") for pt in pts[:4]]
+    assert hpke.open_ciphertexts_batch(kp, b"i", cts, [b"a"] * 4) == pts[:4]
+
+
+def test_batch_open_python_fallback_contract():
+    """The Python loop behind open_ciphertexts_batch (used when the native
+    module is unavailable or the suite isn't native-supported) honors the
+    same per-lane contract."""
+    import os
+
+    kp = hpke.HpkeKeypair.generate(1)
+    pts = [os.urandom(30 + i) for i in range(3)]
+    cts = [hpke.seal(kp.config, b"i", pt, b"a") for pt in pts]
+
+    import janus_tpu.native as native_mod
+
+    saved = native_mod.hpke_open_batch
+    native_mod.hpke_open_batch = lambda *a, **k: None  # force fallback
+    try:
+        res = hpke.open_ciphertexts_batch(kp, b"i", cts, [b"a"] * 3)
+        assert res == pts
+        res = hpke.open_ciphertexts_batch(kp, b"i", cts, [b"a", b"x", b"a"])
+        assert res[1] is None and res[0] == pts[0] and res[2] == pts[2]
+    finally:
+        native_mod.hpke_open_batch = saved
