@@ -231,10 +231,13 @@ def _load_hpke():
         # .so directly when the plain -lcrypto symlink is absent
         import ctypes.util
 
+        import platform
+
         soname = ctypes.util.find_library("crypto") or "libcrypto.so.3"
         link: tuple[str, ...] = ("-lcrypto",)
-        for d in ("/lib/x86_64-linux-gnu", "/usr/lib/x86_64-linux-gnu",
-                  "/usr/lib", "/lib"):
+        multiarch = f"{platform.machine()}-linux-gnu"
+        for d in (f"/lib/{multiarch}", f"/usr/lib/{multiarch}",
+                  "/usr/lib64", "/usr/lib", "/lib"):
             cand = os.path.join(d, soname)
             if os.path.exists(cand):
                 link = (cand,)
