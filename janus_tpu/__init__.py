@@ -34,20 +34,55 @@ A from-scratch re-design of the capabilities of the reference implementation
 __version__ = "0.1.0"
 
 
+def _host_arch_tag() -> str:
+    """A short fingerprint of the host CPU microarchitecture.
+
+    XLA:CPU AOT cache entries record the compile machine's feature set;
+    loading them on a host with FEWER features falls back to slow per-
+    executable fixups (~seconds per load, with SIGILL-risk warnings).  The
+    cache volume persists across heterogeneous machines in this deployment,
+    so the default cache path is segregated per feature set — a mismatched
+    host simply repopulates its own subdirectory.
+    """
+    import hashlib
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = hashlib.sha256(
+                        " ".join(sorted(line.split(":", 1)[1].split()))
+                        .encode()).hexdigest()[:8]
+                    return f"{tag}-{feats}"
+    except OSError:
+        pass
+    return tag
+
+
 def enable_compilation_cache(path: str | None = None) -> None:
     """Enable JAX's persistent compilation cache for the VDAF kernels.
 
     The batch-prepare executables are large (wide field-limb arithmetic);
     caching them makes every process after the first start in milliseconds.
-    Called by the test suite, bench.py, and the aggregator binaries.
+    Called by the test suite, bench.py, and the aggregator binaries.  The
+    default directory is keyed by host microarchitecture (_host_arch_tag)
+    so entries compiled on one machine never mis-load on another.
     """
     import os
 
     import jax
 
-    cache_dir = path or os.environ.get(
-        "JANUS_TPU_COMPILATION_CACHE", os.path.expanduser("~/.cache/janus_tpu_xla")
-    )
+    cache_dir = path
+    if cache_dir is None:
+        # the arch tag applies to the env-var path too: that is exactly how
+        # shared cache volumes are configured (deploy/Dockerfile), and a
+        # shared volume across heterogeneous hosts is the mis-load scenario
+        base = os.environ.get(
+            "JANUS_TPU_COMPILATION_CACHE",
+            os.path.expanduser("~/.cache/janus_tpu_xla"))
+        cache_dir = os.path.join(base, _host_arch_tag())
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -83,13 +83,6 @@ long parse_prepare_inits(const uint8_t* buf, long len, long max_reports,
     return off == len ? n : -1;
 }
 
-// Batched XOR-of-SHA256 checksum support: XOR `n` 32-byte digests into out.
-void xor_digests(const uint8_t* digests, long n, uint8_t* out /* 32 */) {
-    for (int i = 0; i < 32; ++i) out[i] = 0;
-    for (long k = 0; k < n; ++k)
-        for (int i = 0; i < 32; ++i) out[i] ^= digests[k * 32 + i];
-}
-
 // PrepareContinue vector scanner (continue-direction hot path; layout
 // messages/src/lib.rs:2373): PrepareContinue = report_id[16] || opaque32
 // message.  Output row (3 x int64): [id_off, msg_off, msg_len].
