@@ -26,6 +26,7 @@ from janus_tpu.config import (
     DriverBinaryConfig,
     load_config,
 )
+from janus_tpu import trace
 from janus_tpu.core.time import RealClock
 from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
 
@@ -66,6 +67,38 @@ def build_datastore(common, datastore_keys: list[str] | None) -> Datastore:
     return ds
 
 
+def _probe_accelerator() -> None:
+    """Initialize the JAX backend up front; fall back to CPU if it fails.
+
+    The accelerator can be single-tenant (one tunneled chip per host): when
+    several service processes start together, whichever initializes first
+    owns it and the others' backend init raises.  Without this probe the
+    failure would instead surface lazily inside a request handler (the
+    engine modules build device constants at import) and 500 every request.
+    A service on the CPU path stays fully correct — the kernels are
+    platform-agnostic — just slower.
+    """
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        trace.info("accelerator initialized", platform=dev.platform)
+    except Exception as e:
+        reason = str(e).splitlines()[0] if str(e) else repr(e)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+            jax.devices()
+        except Exception as e2:  # pragma: no cover - no backend at all
+            trace.error("no usable JAX backend",
+                        error=str(e2) or repr(e2))
+            raise
+        trace.warn("accelerator unavailable; falling back to CPU",
+                   error=reason)
+
+
 def janus_main(argv, config_cls, run):
     """Parse options, load config, build datastore, run under a stop event
     (reference binary_utils.rs:243)."""
@@ -74,11 +107,10 @@ def janus_main(argv, config_cls, run):
     parser.add_argument("--datastore-keys", action="append", default=None)
     args = parser.parse_args(argv)
     cfg = load_config(config_cls, args.config_file)
-    from janus_tpu.trace import TraceConfiguration, install_trace_subscriber
-
-    install_trace_subscriber(TraceConfiguration(
+    trace.install_trace_subscriber(trace.TraceConfiguration(
         level=cfg.common.logging_level,
         use_json=os.environ.get("JANUS_LOG_FORMAT") == "json"))
+    _probe_accelerator()
     ds = build_datastore(cfg.common, args.datastore_keys)
     health = None
     if cfg.common.health_check_listen_address:
