@@ -21,6 +21,11 @@ echo "== multi-chip dryrun (8-device virtual mesh) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "== smoke bench =="
-BENCH_SMOKE=1 python bench.py
+# representative subset: first cold run compiles per-config kernels, so the
+# smoke gates on one small-job config, the north-star circuit, and the full
+# service-plane handler rather than every VDAF family
+BENCH_SMOKE=1 \
+BENCH_CONFIGS=Prio3Count,Prio3SumVec1000,ServicePlaneHelperInit \
+python bench.py
 
 echo "CI OK"
