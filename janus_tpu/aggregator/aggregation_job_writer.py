@@ -127,8 +127,8 @@ class AggregationJobWriter:
 
         if self.initial:
             tx.put_aggregation_job(job)
-            for w in final:
-                tx.put_report_aggregation(w.report_aggregation)
+            tx.put_report_aggregations_batch(
+                [w.report_aggregation for w in final])
         else:
             tx.update_aggregation_job(job)
             for w in final:

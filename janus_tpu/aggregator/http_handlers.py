@@ -44,6 +44,12 @@ PROBLEM_JSON = "application/problem+json"
 _ROUTES = [
     ("GET", re.compile(r"^/hpke_config$"), "hpke_config"),
     ("PUT", re.compile(r"^/tasks/([^/]+)/reports$"), "upload"),
+    # CORS preflights for the two browser-reachable endpoints (reference
+    # http_handlers.rs:391,429: hpke_config_cors_preflight /
+    # upload_cors_preflight); every other route is aggregator-to-aggregator
+    # and deliberately has no CORS surface.
+    ("OPTIONS", re.compile(r"^/hpke_config$"), "preflight_hpke"),
+    ("OPTIONS", re.compile(r"^/tasks/([^/]+)/reports$"), "preflight_upload"),
     ("PUT", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "agg_init"),
     ("POST", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "agg_cont"),
     ("DELETE", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "agg_del"),
@@ -106,15 +112,23 @@ class DapRouter:
             status, doc = e.problem_document()
             http_request_duration.observe(_t.monotonic() - t0, route=route,
                                           status=status)
+            # browser-reachable routes keep CORS headers on FAILURES too,
+            # else the browser hides the problem document from the client
+            cors = (self._cors_headers(headers)
+                    if route in ("hpke_config", "upload") else {})
             if status == 204:
-                return _Response(204)
-            return _Response(status, json.dumps(doc).encode(), PROBLEM_JSON)
+                return _Response(204, headers=cors)
+            return _Response(status, json.dumps(doc).encode(), PROBLEM_JSON,
+                             headers=cors)
         except Exception:
             traceback.print_exc()
             http_request_duration.observe(_t.monotonic() - t0, route=route,
                                           status=500)
+            cors = (self._cors_headers(headers)
+                    if route in ("hpke_config", "upload") else {})
             return _Response(500, json.dumps({
-                "status": 500, "detail": "internal error"}).encode(), PROBLEM_JSON)
+                "status": 500, "detail": "internal error"}).encode(),
+                PROBLEM_JSON, headers=cors)
 
     # -- route handlers ----------------------------------------------------
 
@@ -124,13 +138,46 @@ class DapRouter:
             task_id = TaskId.from_str(query["task_id"][0])
         data = self.aggregator.handle_hpke_config(task_id)
         return _Response(200, data, HpkeConfigList.MEDIA_TYPE,
-                         {"Cache-Control": "max-age=86400"})
+                         {"Cache-Control": "max-age=86400",
+                          **self._cors_headers(headers)})
 
     def _upload(self, match, query, body, headers) -> _Response:
         self._check_content_type(headers, Report.MEDIA_TYPE)
         task_id = TaskId.from_str(match.group(1))
         self.aggregator.handle_upload(task_id, body)
-        return _Response(201)
+        return _Response(201, headers=self._cors_headers(headers))
+
+    # -- CORS (browser-based DAP clients; reference http_handlers.rs:376-431)
+
+    @staticmethod
+    def _cors_headers(headers) -> dict:
+        origin = headers.get("Origin")
+        if not origin:
+            return {}
+        return {"Access-Control-Allow-Origin": origin, "Vary": "Origin"}
+
+    def _preflight_hpke(self, match, query, body, headers) -> _Response:
+        return self._preflight(headers, "GET", allow_headers=None)
+
+    def _preflight_upload(self, match, query, body, headers) -> _Response:
+        return self._preflight(headers, "PUT", allow_headers="content-type")
+
+    @staticmethod
+    def _preflight(headers, methods: str,
+                   allow_headers: str | None) -> _Response:
+        origin = headers.get("Origin")
+        if not origin:
+            # not a CORS preflight: nothing to advertise
+            return _Response(204)
+        h = {
+            "Access-Control-Allow-Origin": origin,
+            "Access-Control-Allow-Methods": methods,
+            "Access-Control-Max-Age": "86400",
+            "Vary": "Origin",
+        }
+        if allow_headers:
+            h["Access-Control-Allow-Headers"] = allow_headers
+        return _Response(204, headers=h)
 
     def _agg_init(self, match, query, body, headers) -> _Response:
         from janus_tpu.messages.taskprov import TASKPROV_HEADER
@@ -234,6 +281,9 @@ class DapHttpServer:
 
             def do_DELETE(self):
                 self._run("DELETE")
+
+            def do_OPTIONS(self):
+                self._run("OPTIONS")
 
         self.server = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None

@@ -31,6 +31,90 @@ class CollectorError(Exception):
     pass
 
 
+# JSON enum spellings shared by the collector credential ecosystem
+# (reference collector/src/credential.rs over hpke_dispatch's serde names),
+# mapped straight onto the wire enums so the numeric codes live in ONE place.
+from janus_tpu.messages import HpkeAeadId, HpkeKdfId, HpkeKemId  # noqa: E402
+
+_KEM_NAMES = {"X25519HkdfSha256": HpkeKemId.X25519_HKDF_SHA256.code,
+              "DhP256HkdfSha256": HpkeKemId.P256_HKDF_SHA256.code}
+_KDF_NAMES = {"Sha256": HpkeKdfId.HKDF_SHA256.code,
+              "Sha384": HpkeKdfId.HKDF_SHA384.code,
+              "Sha512": HpkeKdfId.HKDF_SHA512.code}
+_AEAD_NAMES = {"AesGcm128": HpkeAeadId.AES_128_GCM.code,
+               "AesGcm256": HpkeAeadId.AES_256_GCM.code,
+               "ChaCha20Poly1305": HpkeAeadId.CHACHA20_POLY1305.code}
+
+
+@dataclass(frozen=True)
+class PrivateCollectorCredential:
+    """Everything a collector needs to talk to an aggregator: the bearer
+    token and the private HPKE configuration for opening aggregate shares
+    (reference collector/src/credential.rs:14 — same JSON format, so
+    credentials issued by the wider DAP ecosystem load unchanged)."""
+
+    id: int
+    kem: str
+    kdf: str
+    aead: str
+    public_key: bytes
+    private_key: bytes
+    token: str
+
+    @classmethod
+    def from_json(cls, text: str | bytes) -> "PrivateCollectorCredential":
+        import base64
+        import json as _json
+
+        doc = _json.loads(text)
+
+        def unb64(s: str) -> bytes:
+            return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+        for field, table in (("kem", _KEM_NAMES), ("kdf", _KDF_NAMES),
+                             ("aead", _AEAD_NAMES)):
+            if doc[field] not in table:
+                raise CollectorError(
+                    f"unrecognized {field} {doc[field]!r} in credential")
+        return cls(
+            id=int(doc["id"]), kem=doc["kem"], kdf=doc["kdf"],
+            aead=doc["aead"], public_key=unb64(doc["public_key"]),
+            private_key=unb64(doc["private_key"]), token=doc["token"])
+
+    def to_json(self) -> str:
+        import base64
+        import json as _json
+
+        def b64(b: bytes) -> str:
+            return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+        return _json.dumps({
+            "aead": self.aead, "id": self.id, "kdf": self.kdf,
+            "kem": self.kem, "private_key": b64(self.private_key),
+            "public_key": b64(self.public_key), "token": self.token,
+        }, indent=2, sort_keys=True)
+
+    def hpke_keypair(self) -> HpkeKeypair:
+        from janus_tpu.messages import (
+            HpkeAeadId,
+            HpkeConfig,
+            HpkeConfigId,
+            HpkeKdfId,
+            HpkeKemId,
+            HpkePublicKey,
+        )
+
+        return HpkeKeypair(
+            HpkeConfig(HpkeConfigId(self.id), HpkeKemId(_KEM_NAMES[self.kem]),
+                       HpkeKdfId(_KDF_NAMES[self.kdf]),
+                       HpkeAeadId(_AEAD_NAMES[self.aead]),
+                       HpkePublicKey(self.public_key)),
+            self.private_key)
+
+    def authentication_token(self) -> AuthenticationToken:
+        return AuthenticationToken.bearer(self.token)
+
+
 @dataclass
 class CollectionResult:
     """reference collector/src/lib.rs:214."""

@@ -261,37 +261,233 @@ def open_ciphertext(keypair: "HpkeKeypair", application_info: bytes,
         raise HpkeError("HPKE open failed") from e
 
 
+def _device_hpke_auto(n: int) -> bool:
+    """Default policy for routing a batch open to the TPU: explicit env
+    override first, else device when an accelerator is attached and the
+    batch amortizes the launch."""
+    import os
+
+    flag = os.environ.get("JANUS_TPU_DEVICE_HPKE")
+    if flag is not None:
+        return flag.strip().lower() not in ("0", "false", "no", "off", "")
+    if n < int(os.environ.get("JANUS_TPU_DEVICE_HPKE_MIN", "2048")):
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
 def open_ciphertexts_batch(keypair: "HpkeKeypair", application_info: bytes,
                            ciphertexts: list[HpkeCiphertext],
-                           aads: list[bytes]) -> list[bytes | None]:
-    """Open many ciphertexts under one keypair/info: one GIL-free native
-    pass for the DAP-default suites (native/hpke_open.cpp), the per-report
-    Python path otherwise.  Per-lane results: plaintext or None (failed) —
-    a failed lane never aborts the batch (the caller maps None to
+                           aads: list[bytes],
+                           prefer_device: bool | None = None
+                           ) -> list[bytes | None]:
+    """Open many ciphertexts under one keypair/info.  Three engines, best
+    first: the TPU kernel for the DAP-default suite (ops/hpke_device.py —
+    X25519 + HKDF + AES-GCM as one batched program, freeing the host core),
+    the GIL-free native pass (native/hpke_open.cpp), then the per-report
+    Python path.  Per-lane results: plaintext or None (failed) — a failed
+    lane never aborts the batch (the caller maps None to
     PrepareError::HpkeDecryptError, reference aggregator.rs:1800)."""
+    if len(ciphertexts) != len(aads):
+        raise ValueError(
+            f"ciphertexts/aads length mismatch: {len(ciphertexts)} != {len(aads)}")
+    return open_ciphertexts_batch_raw(
+        keypair, application_info,
+        [ct.encapsulated_key for ct in ciphertexts],
+        [ct.payload for ct in ciphertexts], aads, prefer_device)
+
+
+def open_ciphertexts_batch_raw(keypair: "HpkeKeypair",
+                               application_info: bytes,
+                               encs: list[bytes], payloads: list[bytes],
+                               aads: list[bytes],
+                               prefer_device: bool | None = None
+                               ) -> list[bytes | None]:
+    """open_ciphertexts_batch on raw wire components — the columnar
+    aggregate-init path calls this without building HpkeCiphertext
+    objects."""
+    if not (len(encs) == len(payloads) == len(aads)):
+        raise ValueError("encs/payloads/aads length mismatch")
     config = keypair.config
     if not is_hpke_config_supported(config):
         raise HpkeError("unsupported HPKE configuration")
+    device_ok = (
+        config.kem_id.code == HpkeKemId.X25519_HKDF_SHA256.code
+        and config.kdf_id.code == HpkeKdfId.HKDF_SHA256.code
+        and config.aead_id.code == HpkeAeadId.AES_128_GCM.code
+    )
+    if prefer_device is None:
+        prefer_device = _device_hpke_auto(len(encs))
+    if (device_ok and prefer_device and len(encs) > 1
+            and not _device_disabled()):
+        try:
+            return _open_batch_hybrid(keypair, application_info, encs,
+                                      payloads, aads)
+        except Exception:
+            # the native/Python paths still work; latch the device path off
+            # after repeated failures so a broken kernel doesn't tax every
+            # request with a doomed attempt (and log the first failure —
+            # silent degradation was a round-4 review finding)
+            _device_failed()
+    # The native path stages LabeledExtract/Expand messages in fixed
+    # 512-byte buffers; an oversized `info` would fail every lane there
+    # while the Python path succeeds.  DAP's info strings are tiny, but
+    # keep the two paths behaviorally identical.
     native_ok = (
         config.kem_id.code == HpkeKemId.X25519_HKDF_SHA256.code
         and config.kdf_id.code == HpkeKdfId.HKDF_SHA256.code
+        and len(application_info) <= 400
     )
-    if native_ok and len(ciphertexts) > 1:
+    if native_ok and len(encs) > 1:
         from janus_tpu import native
 
         res = native.hpke_open_batch(
             keypair.private_key, config.public_key.data,
-            config.aead_id.code, application_info,
-            [ct.encapsulated_key for ct in ciphertexts],
-            [ct.payload for ct in ciphertexts], aads)
+            config.aead_id.code, application_info, encs, payloads, aads)
         if res is not None:
             return res
     out: list[bytes | None] = []
-    for ct, aad in zip(ciphertexts, aads):
+    for enc, payload, aad in zip(encs, payloads, aads):
         try:
-            out.append(open_ciphertext(keypair, application_info, ct, aad))
+            out.append(open_ciphertext(
+                keypair, application_info,
+                HpkeCiphertext(config.id, enc, payload), aad))
         except HpkeError:
             out.append(None)
+    return out
+
+
+_device_failures = 0
+_DEVICE_FAILURE_LIMIT = 3
+
+
+def _device_disabled() -> bool:
+    return _device_failures >= _DEVICE_FAILURE_LIMIT
+
+
+def _device_failed() -> None:
+    global _device_failures
+    _device_failures += 1
+    import logging
+
+    log = logging.getLogger("janus_tpu.hpke")
+    if _device_failures == 1:
+        log.warning("device HPKE open failed; falling back to native/CPU",
+                    exc_info=True)
+    if _device_failures == _DEVICE_FAILURE_LIMIT:
+        log.warning("device HPKE open disabled after %d failures",
+                    _device_failures)
+
+
+class _HybridTuner:
+    """Adaptive device/CPU split for the batch open.  The TPU kernel and
+    the GIL-free native pass run CONCURRENTLY on disjoint lane ranges —
+    their rates ADD — and the split fraction tracks the measured rates so
+    the two sides finish together (EWMA; starts at an even split)."""
+
+    def __init__(self):
+        import threading
+
+        self.frac = 0.5
+        self._lock = threading.Lock()
+
+    def update(self, dev_rate: float, cpu_rate: float) -> None:
+        if dev_rate <= 0 or cpu_rate <= 0:
+            return
+        target = dev_rate / (dev_rate + cpu_rate)
+        with self._lock:
+            self.frac = 0.7 * self.frac + 0.3 * target
+
+
+_hybrid = _HybridTuner()
+_hybrid_pool = None
+_hybrid_pool_lock = __import__("threading").Lock()
+
+
+def _hybrid_executor():
+    global _hybrid_pool
+    with _hybrid_pool_lock:
+        if _hybrid_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _hybrid_pool = ThreadPoolExecutor(1, thread_name_prefix="hpke-dev")
+        return _hybrid_pool
+
+
+def _open_batch_hybrid(keypair: "HpkeKeypair", application_info: bytes,
+                       encs: list[bytes], payloads: list[bytes],
+                       aads: list[bytes]) -> list[bytes | None]:
+    """Split the batch across the TPU kernel and the native CPU pass,
+    running both at once.  Falls back to device-only when the native
+    module is unavailable."""
+    import time as _t
+
+    from janus_tpu import native
+
+    n = len(encs)
+    if not (native.hpke_available() and len(application_info) <= 400
+            and n >= 512):
+        return _open_batch_device(keypair, application_info, encs, payloads,
+                                  aads)
+    # lanes 0..k-1 -> device; the split is quantized to quarters and then
+    # snapped DOWN to the kernel's bucket grid, so the device runs with
+    # zero padding and at most a couple of stable shapes per job size —
+    # a raw adaptive k would trigger a fresh XLA compile (minutes on this
+    # kernel) every time the measured ratio drifted a little
+    from janus_tpu.ops.hpke_device import bucket_floor
+
+    frac_q = min(0.75, max(0.25, round(_hybrid.frac * 4) / 4))
+    k = min(n - 1, max(1, bucket_floor(int(n * frac_q))))
+    config = keypair.config
+
+    def dev_part():
+        t0 = _t.monotonic()
+        res = _open_batch_device(keypair, application_info, encs[:k],
+                                 payloads[:k], aads[:k])
+        return res, k / max(_t.monotonic() - t0, 1e-9)
+
+    fut = _hybrid_executor().submit(dev_part)
+    t0 = _t.monotonic()
+    cpu_res = native.hpke_open_batch(
+        keypair.private_key, config.public_key.data, config.aead_id.code,
+        application_info, encs[k:], payloads[k:], aads[k:])
+    cpu_rate = (n - k) / max(_t.monotonic() - t0, 1e-9)
+    dev_res, dev_rate = fut.result()
+    if cpu_res is None:  # native refused at run time: do the tail on device
+        cpu_res = _open_batch_device(keypair, application_info, encs[k:],
+                                     payloads[k:], aads[k:])
+    else:
+        _hybrid.update(dev_rate, cpu_rate)
+    return dev_res + cpu_res
+
+
+def _open_batch_device(keypair: "HpkeKeypair", application_info: bytes,
+                       encs: list[bytes], payloads: list[bytes],
+                       aads: list[bytes]) -> list[bytes | None]:
+    """Route lanes to the TPU kernel, grouped by (ct_len, aad_len) — the
+    kernel compiles per static shape.  Lanes that can never open (bad enc
+    size, payload shorter than a GCM tag) resolve to None directly."""
+    from janus_tpu.ops import hpke_device
+
+    n = len(encs)
+    out: list[bytes | None] = [None] * n
+    groups: dict[tuple[int, int], list[int]] = {}
+    for i in range(n):
+        if len(encs[i]) != 32 or len(payloads[i]) < 16:
+            continue  # undecryptable however we route it
+        groups.setdefault((len(payloads[i]), len(aads[i])), []).append(i)
+    for idxs in groups.values():
+        res = hpke_device.open_batch(
+            keypair.private_key, keypair.config.public_key.data,
+            application_info,
+            [encs[i] for i in idxs], [payloads[i] for i in idxs],
+            [aads[i] for i in idxs])
+        for i, pt in zip(idxs, res):
+            out[i] = pt
     return out
 
 

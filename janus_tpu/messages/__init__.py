@@ -945,6 +945,29 @@ class AggregationJobInitializeReq(WireMessage):
 
     decode_from = decode_expecting
 
+    @classmethod
+    def decode_columns(cls, data: bytes, expect: QueryType | None = None):
+        """Columnar decode for the helper's hot path: ONE native pass over
+        the PrepareInit vector, NO per-report message objects.  Returns
+        (aggregation_parameter, partial_batch_selector, body, table) where
+        `table` is the int64 [n, 11] offset table into `body`
+        (janus_tpu.native.parse_prepare_inits column order), or None when
+        the native scanner is unavailable (callers use the object path).
+        Raises DecodeError on malformed input, like decode()."""
+        from janus_tpu import native
+
+        if not native.available():
+            return None
+        cur = Cursor(data)
+        agg_param = cur.opaque32()
+        pbs = PartialBatchSelector.decode_expecting(cur, expect)
+        body = cur.opaque32()
+        cur.finish()
+        table = native.parse_prepare_inits(body)
+        if table is None:
+            raise DecodeError("malformed PrepareInit vector")
+        return agg_param, pbs, body, table
+
 
 @dataclass(frozen=True)
 class AggregationJobContinueReq(WireMessage):

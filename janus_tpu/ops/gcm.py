@@ -1,0 +1,165 @@
+"""Batched AES-128-GCM open on device (NIST SP 800-38D semantics).
+
+The last stage of the device-side HPKE open (janus_tpu.ops.hpke_device):
+after X25519 + HKDF produce a per-lane AES key and nonce, every report
+share decrypts and authenticates in one vectorized program — the
+reference's per-report `hpke::open` loop (aggregator/src/aggregator.rs:1772)
+recast for a machine whose unit of work is the batch.
+
+Design notes (TPU):
+- AES blocks run through the existing bitsliced kernel
+  (janus_tpu.ops.hmac_aes.aes128_encrypt_planes); the H subkey, E(J0) tag
+  mask, and the whole CTR keystream for a lane are ONE packed plane batch.
+- GHASH works in GF(2^128) on [N, 4]-u32 big-endian limb vectors.  Instead
+  of clmul (absent on any vector unit here), multiplication BY THE FIXED
+  per-lane subkey H is linear over GF(2): a 128-step scan precomputes the
+  "shift table" V_j = H·x^j (j = 0..127), and each Horner step reduces to
+  a masked XOR-fold of that table — the per-block cost is data-independent
+  and fully vectorized over lanes.
+- Static shapes only: one jitted program per (N bucket, ct_len, aad_len).
+  Lanes with divergent lengths take the host path upstream.
+
+Failure semantics: per-lane `ok` flag (tag mismatch -> False); plaintext
+bytes for failed lanes are unspecified and must be discarded by the
+caller.  Bit-exactness is pinned against the host `cryptography` AESGCM in
+tests/test_gcm.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from janus_tpu.ops.hmac_aes import (
+    _ctr_counters,
+    _pack_block_bits,
+    _planes_to_words,
+    aes128_encrypt_planes,
+    aes128_key_schedule,
+    make_key_planes,
+)
+
+_U32 = jnp.uint32
+_U8 = jnp.uint8
+
+# x^128 + x^7 + x^2 + x + 1 in GCM's reflected representation
+_R_TOP = _U32(0xE1000000)
+
+
+def _bytes_to_be_limbs(blocks):
+    """u8 [..., 16] -> u32 [..., 4] big-endian limbs (limb 0 = bytes 0-3)."""
+    b = blocks.astype(_U32)
+    return jnp.stack(
+        [(b[..., 4 * i] << _U32(24)) | (b[..., 4 * i + 1] << _U32(16))
+         | (b[..., 4 * i + 2] << _U32(8)) | b[..., 4 * i + 3]
+         for i in range(4)], axis=-1)
+
+
+def _shift_table(h):
+    """V_j = H · x^j for j in 0..127 -> [128, N, 4] u32.
+
+    Recurrence (SP 800-38D right-shift convention on the big-endian
+    integer view): V_{j+1} = (V_j >> 1) ^ (lsb(V_j) ? R : 0)."""
+
+    def step(v, _):
+        lsb = v[..., 3] & _U32(1)
+        shifted = jnp.stack(
+            [v[..., 0] >> _U32(1),
+             (v[..., 1] >> _U32(1)) | (v[..., 0] << _U32(31)),
+             (v[..., 2] >> _U32(1)) | (v[..., 1] << _U32(31)),
+             (v[..., 3] >> _U32(1)) | (v[..., 2] << _U32(31))], axis=-1)
+        red = jnp.zeros_like(v).at[..., 0].set(lsb * _R_TOP)
+        return shifted ^ red, v
+
+    _, table = lax.scan(step, h, None, length=128)
+    return table  # [128, N, 4]
+
+
+def _bits_msb_first(z):
+    """[N, 4] u32 BE limbs -> [128, N] u32 0/1 masks, bit 127 (MSB of byte
+    0) first — the iteration order of the shift table."""
+    shifts = jnp.arange(31, -1, -1, dtype=_U32)  # 31..0
+    bits = (z[..., :, None] >> shifts[None, None, :]) & _U32(1)  # [N,4,32]
+    return jnp.transpose(bits.reshape(z.shape[0], 128), (1, 0))
+
+
+def _ghash_mul_table(table, z):
+    """z · H via the precomputed table: masked XOR fold over 128 rows."""
+    masks = _U32(0) - _bits_msb_first(z)  # [128, N], 0 or ~0
+    contrib = table & masks[..., None]  # [128, N, 4]
+    return lax.reduce(contrib, np.uint32(0), lax.bitwise_xor, [0])
+
+
+def aes128_gcm_open(key, nonce, aad, ct):
+    """Batched AES-128-GCM open.
+
+    key [N,16] u8, nonce [N,12] u8, aad [N,A] u8, ct [N,C] u8 with the
+    16-byte tag trailing (C >= 16).  Returns (pt [N, C-16] u8, ok [N] bool).
+    A and C are static per compiled program."""
+    N = key.shape[0]
+    A = aad.shape[-1]
+    C = ct.shape[-1]
+    assert C >= 16, "ciphertext must include the 16-byte tag"
+    pt_len = C - 16
+    nb = -(-pt_len // 16)  # keystream blocks
+
+    # One bitsliced AES pass for H, E(J0), and the keystream:
+    # lane blocks = [0^16, J0, J0+1, ..., J0+nb]
+    j0 = jnp.concatenate(
+        [nonce, jnp.zeros((N, 3), dtype=_U8),
+         jnp.full((N, 1), 1, dtype=_U8)], axis=-1)  # [N, 16]
+    ctrs = _ctr_counters(j0, nb + 1)  # J0, J0+1, ..., J0+nb
+    blocks = jnp.concatenate(
+        [jnp.zeros((N, 1, 16), dtype=_U8), ctrs], axis=1)  # [N, nb+2, 16]
+    npad = -(-(nb + 2) // 32) * 32
+    planes = _pack_block_bits(blocks, npad)
+    rkp = make_key_planes(aes128_key_schedule(key))
+    enc_planes = aes128_encrypt_planes(planes, rkp)
+    words = _planes_to_words(enc_planes)  # [4, N, npad] LE u32 words
+    # [N, npad, 4 words] -> u8 [N, npad, 16]
+    enc_bytes = lax.bitcast_convert_type(
+        jnp.transpose(words, (1, 2, 0)), _U8).reshape(N, npad, 16)
+    h = _bytes_to_be_limbs(enc_bytes[:, 0])       # [N, 4]
+    ej0 = enc_bytes[:, 1]                          # [N, 16]
+    keystream = enc_bytes[:, 2:2 + nb].reshape(N, nb * 16)[:, :pt_len]
+
+    pt = ct[:, :pt_len] ^ keystream
+
+    # GHASH(aad || ct || len64(aad)*8 || len64(ct)*8) via Horner
+    table = _shift_table(h)
+
+    def pad16(x):
+        pad = (-x.shape[-1]) % 16
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((N, pad), dtype=_U8)], axis=-1)
+        return x.reshape(N, -1, 16)
+
+    len_block = np.zeros(16, dtype=np.uint8)
+    len_block[:8] = np.frombuffer((8 * A).to_bytes(8, "big"), np.uint8)
+    len_block[8:] = np.frombuffer((8 * pt_len).to_bytes(8, "big"), np.uint8)
+    ghash_parts = []
+    if A:
+        ghash_parts.append(pad16(aad))
+    if pt_len:
+        ghash_parts.append(pad16(ct[:, :pt_len]))
+    ghash_parts.append(jnp.broadcast_to(jnp.asarray(len_block),
+                                        (N, 16)).reshape(N, 1, 16))
+    ghash_blocks = _bytes_to_be_limbs(
+        jnp.concatenate(ghash_parts, axis=1))  # [N, M, 4]
+    blocks_scan = jnp.moveaxis(ghash_blocks, 1, 0)  # [M, N, 4]
+
+    def horner(s, x):
+        return _ghash_mul_table(table, s ^ x), None
+
+    s0 = jnp.zeros((N, 4), dtype=_U32)
+    s, _ = lax.scan(horner, s0, blocks_scan)
+
+    # tag = E(J0) ^ GHASH; constant-time-style full compare per lane
+    s_bytes = jnp.stack(
+        [(s[..., i // 4] >> _U32(24 - 8 * (i % 4))).astype(_U8)
+         for i in range(16)], axis=-1)  # [N, 16]
+    tag = ej0 ^ s_bytes
+    ok = jnp.all(tag == ct[:, pt_len:], axis=-1)
+    return pt, ok

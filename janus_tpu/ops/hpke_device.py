@@ -1,0 +1,164 @@
+"""Whole-batch HPKE open on device: X25519 + HKDF-SHA256 + AES-128-GCM.
+
+The reference helper spends its aggregate-init handler opening report
+shares one at a time on CPU (aggregator/src/aggregator.rs:1772).  This
+framework's service runs beside a TPU whose VDAF kernels leave it idle
+during the host bracket — so the full RFC 9180 open for the DAP-default
+suite (DHKEM-X25519/HKDF-SHA256/AES-128-GCM) becomes ONE device program
+over all lanes:
+
+    dh      = X25519(sk_R, enc_i)                 (ops/x25519.py ladder)
+    shared  = LabeledExtract/Expand(dh, enc_i||pk_R)   (batched HMAC)
+    key/nonce = KeySchedule(shared, info)          (info terms hoisted to
+                                                    host constants)
+    pt, ok  = AES-128-GCM-open(key, nonce, aad_i, ct_i)  (ops/gcm.py)
+
+Per-lane failure only: a bad point / tag mismatch flips that lane's `ok`.
+Static shapes: one compiled program per (lane bucket, ct_len, aad_len);
+callers with ragged lengths split lanes by length upstream
+(core/hpke.py.open_ciphertexts_batch) and stragglers take the native/host
+path.  Bit-exactness is pinned against the host RFC 9180 implementation
+(which itself passes the CFRG KATs) in tests/test_hpke_device.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.ops import x25519
+from janus_tpu.ops.gcm import aes128_gcm_open
+from janus_tpu.ops.hmac_aes import hmac_sha256
+
+_U8 = jnp.uint8
+
+_KEM_SUITE = b"KEM\x00\x20"
+_SUITE = b"HPKE\x00\x20\x00\x01\x00\x01"  # KEM x25519 | KDF sha256 | AEAD 1
+_V1 = b"HPKE-v1"
+
+
+def _const(batch: int, data: bytes):
+    return jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(data, np.uint8)), (batch, len(data)))
+
+
+def _key_schedule_context(info: bytes) -> bytes:
+    """mode_base context: 0x00 || psk_id_hash || info_hash — lane-invariant,
+    so computed on host (mirrors core/hpke.py _key_and_nonce)."""
+
+    def labeled_extract(salt: bytes, label: bytes, ikm: bytes) -> bytes:
+        return _hmac.new(salt or b"\x00" * 32, _V1 + _SUITE + label + ikm,
+                         hashlib.sha256).digest()
+
+    psk_id_hash = labeled_extract(b"", b"psk_id_hash", b"")
+    info_hash = labeled_extract(b"", b"info_hash", info)
+    return b"\x00" + psk_id_hash + info_hash
+
+
+def _open_kernel(sk, pk_r, ksc, encs, cts, aads):
+    """The jitted body: sk [32]u8 clamped, pk_r [32]u8, ksc [65]u8,
+    encs [N,32], cts [N,C], aads [N,A] -> (pt [N,C-16], ok [N])."""
+    n = encs.shape[0]
+    dh, nonzero = x25519.scalar_mult(sk, encs)
+
+    def lext(key, label: bytes, ikm):
+        return hmac_sha256(
+            key, jnp.concatenate([_const(n, _V1 + _KEM_SUITE + label), ikm],
+                                 axis=-1))
+
+    def lexp(prk, label: bytes, suite: bytes, info, L: int):
+        msg = jnp.concatenate(
+            [_const(n, L.to_bytes(2, "big") + _V1 + suite + label), info,
+             _const(n, b"\x01")], axis=-1)
+        return hmac_sha256(prk, msg)[..., :L]
+
+    eae_prk = lext(_const(n, b"\x00" * 32), b"eae_prk", dh)
+    kem_context = jnp.concatenate([encs, _const(n, bytes(pk_r))], axis=-1) \
+        if isinstance(pk_r, (bytes, bytearray)) else jnp.concatenate(
+            [encs, jnp.broadcast_to(pk_r, (n, 32))], axis=-1)
+    shared = lexp(eae_prk, b"shared_secret", _KEM_SUITE, kem_context, 32)
+
+    secret = hmac_sha256(shared, _const(n, _V1 + _SUITE + b"secret"))
+    ksc_b = jnp.broadcast_to(ksc, (n, 65))
+    key = lexp(secret, b"key", _SUITE, ksc_b, 16)
+    base_nonce = lexp(secret, b"base_nonce", _SUITE, ksc_b, 12)
+
+    pt, ok = aes128_gcm_open(key, base_nonce, aads, cts)
+    return pt, ok & nonzero
+
+
+_jit_cache: dict[tuple[int, int, int], object] = {}
+_jit_lock = threading.Lock()
+
+
+def _fn_for(n: int, c: int, a: int):
+    key = (n, c, a)
+    with _jit_lock:
+        fn = _jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(_open_kernel)
+            _jit_cache[key] = fn
+    return fn
+
+
+def _bucket(n: int) -> int:
+    """Pad lanes to a small set of sizes so compiles are reused.  ~1.3x
+    geometric steps: the ladder's cost is linear in padded lanes, so
+    power-of-two buckets would waste up to half the kernel time (n=10k
+    padding to 16384); finer steps cap the waste at ~23%."""
+    m = 256
+    while m < n:
+        m = (m * 13 // 10 + 255) // 256 * 256
+    return m
+
+
+def bucket_floor(n: int) -> int:
+    """The largest bucket size <= n (min 256).  Callers that can CHOOSE how
+    many lanes to send (the hybrid CPU/device split) snap DOWN to the grid:
+    the kernel then runs with zero pad waste and the shape set stays small
+    — an adaptive split that picked raw k would compile a fresh program
+    every time the ratio drifted."""
+    m = prev = 256
+    while m <= n:
+        prev = m
+        m = (m * 13 // 10 + 255) // 256 * 256
+    return prev
+
+
+def open_batch(sk_r: bytes, pk_r: bytes, info: bytes,
+               encs: list[bytes], cts: list[bytes], aads: list[bytes]):
+    """Open n uniform-length lanes on device.
+
+    Requires every enc to be 32 bytes and all ct / aad lengths uniform
+    (caller's contract — see core/hpke.py grouping).  Returns a list of
+    (plaintext | None) per lane."""
+    n = len(encs)
+    if n == 0:
+        return []
+    c, a = len(cts[0]), len(aads[0])
+    m = _bucket(n)
+    enc_arr = np.zeros((m, 32), dtype=np.uint8)
+    enc_arr[:n] = np.frombuffer(b"".join(encs), np.uint8).reshape(n, 32)
+    ct_arr = np.zeros((m, c), dtype=np.uint8)
+    if c:
+        ct_arr[:n] = np.frombuffer(b"".join(cts), np.uint8).reshape(n, c)
+    aad_arr = np.zeros((m, a), dtype=np.uint8)
+    if a:
+        aad_arr[:n] = np.frombuffer(b"".join(aads), np.uint8).reshape(n, a)
+    sk = np.frombuffer(x25519.clamp_scalar(sk_r), np.uint8)
+    pk = np.frombuffer(pk_r, np.uint8)
+    ksc = np.frombuffer(_key_schedule_context(info), np.uint8)
+    fn = _fn_for(m, c, a)
+    pt, ok = fn(jnp.asarray(sk), jnp.asarray(pk), jnp.asarray(ksc),
+                jnp.asarray(enc_arr), jnp.asarray(ct_arr),
+                jnp.asarray(aad_arr))
+    pt = np.asarray(pt)
+    ok = np.asarray(ok)
+    blob = pt.tobytes()
+    row = pt.shape[-1]
+    return [blob[i * row:i * row + row] if ok[i] else None for i in range(n)]

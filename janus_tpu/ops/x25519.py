@@ -1,0 +1,159 @@
+"""Batched X25519 (RFC 7748) on device, over janus_tpu.ops.field255.
+
+Why this exists: the helper's aggregate-init handler must HPKE-open every
+report share (reference aggregator/src/aggregator.rs:1772, one
+`hpke::open` per report on CPU threads).  On this framework's target a
+single host core drives the whole service, and the X25519 decap is ~75% of
+the per-report open cost — so the decap moves to the TPU, where ten
+thousand ladders run as one vectorized program while the host stages the
+next pipeline phase.  (SURVEY.md §2.8's "crypto plane on device" P1 taken
+one layer further than the VDAF math.)
+
+Shape/layout contract (matches field255): a batch of field elements is a
+uint32 array [8, N] (limb-leading, batch-minor).  Public API works on byte
+arrays: points/outputs are [N, 32] uint8 little-endian as on the wire.
+
+The scalar (recipient private key) is ONE key for the whole batch — the
+DAP helper opens every report under its own keypair — so the ladder's
+conditional swaps depend only on traced scalar bits, not per-lane data:
+`select` broadcasts one bit across the batch.  Montgomery ladder + final
+inversion via the standard 254-squaring addition chain; no data-dependent
+control flow anywhere (XLA traces one straight-line program).
+
+Bit-exactness: tests/test_x25519.py pins RFC 7748 §5.2 test vectors, the
+iterated-ladder KAT, and random-vector parity against the host HPKE
+implementation (cryptography's X25519).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from janus_tpu.ops import field255 as f
+
+_U32 = jnp.uint32
+_U8 = jnp.uint8
+
+_A24 = 121665  # (486662 - 2) / 4
+
+
+def clamp_scalar(sk: bytes) -> bytes:
+    """RFC 7748 §5 scalar clamping (host side, once per batch)."""
+    b = bytearray(sk)
+    b[0] &= 248
+    b[31] &= 127
+    b[31] |= 64
+    return bytes(b)
+
+
+def _decode_u_coords(points_u8):
+    """[N, 32] u8 little-endian -> [8, N] u32 limbs, canonical (< p).
+
+    RFC 7748: mask the top bit, accept non-canonical values mod p (u is in
+    [0, 2^255), so one conditional subtract canonicalizes)."""
+    pts = points_u8.astype(_U32)  # [N, 32]
+    limbs = (pts[:, 0::4]
+             | (pts[:, 1::4] << _U32(8))
+             | (pts[:, 2::4] << _U32(16))
+             | (pts[:, 3::4] << _U32(24)))  # [N, 8], limb-minor
+    limbs = jnp.transpose(limbs, (1, 0))  # [8, N]
+    limbs = limbs.at[7].set(limbs[7] & _U32(0x7FFFFFFF))  # mask bit 255
+    return f._cond_sub_p([limbs[i] for i in range(8)])
+
+
+def _encode_u_coords(x):
+    """[8, N] u32 canonical limbs -> [N, 32] u8 little-endian."""
+    limbs = jnp.transpose(x, (1, 0))  # [N, 8]
+    bs = [
+        (limbs >> _U32(8 * i)).astype(_U8)[..., None] for i in range(4)
+    ]  # 4 x [N, 8, 1]
+    return jnp.concatenate(bs, axis=-1).reshape(x.shape[1], 32)
+
+
+def _sq(x):
+    return f.mul(x, x)
+
+
+def _pow2k(x, k: int):
+    """x^(2^k): k squarings under lax.scan (compile-size discipline)."""
+
+    def step(c, _):
+        return _sq(c), None
+
+    out, _ = lax.scan(step, x, None, length=k)
+    return out
+
+
+def _invert(z):
+    """z^(p-2) mod p: the standard 2^255-21 addition chain (11 mults +
+    254 squarings), as in every public curve25519 implementation."""
+    z2 = _sq(z)                                   # 2^1
+    z9 = f.mul(_pow2k(z2, 2), z)                  # 2^3 + 1 = 9
+    z11 = f.mul(z9, z2)                           # 11
+    z2_5_0 = f.mul(_sq(z11), z9)                  # 2^5 - 2^0
+    z2_10_0 = f.mul(_pow2k(z2_5_0, 5), z2_5_0)    # 2^10 - 2^0
+    z2_20_0 = f.mul(_pow2k(z2_10_0, 10), z2_10_0)
+    z2_40_0 = f.mul(_pow2k(z2_20_0, 20), z2_20_0)
+    z2_50_0 = f.mul(_pow2k(z2_40_0, 10), z2_10_0)
+    z2_100_0 = f.mul(_pow2k(z2_50_0, 50), z2_50_0)
+    z2_200_0 = f.mul(_pow2k(z2_100_0, 100), z2_100_0)
+    z2_250_0 = f.mul(_pow2k(z2_200_0, 50), z2_50_0)
+    return f.mul(_pow2k(z2_250_0, 5), z11)        # 2^255 - 21
+
+
+def _scalar_bits(scalar_u8):
+    """[32] u8 clamped scalar -> [255] u32 bits, most significant first
+    (bit 254 down to 0; bit 255 is cleared by clamping)."""
+    bits = ((scalar_u8[:, None].astype(_U32)
+             >> jnp.arange(8, dtype=_U32)[None, :]) & _U32(1))
+    le = bits.reshape(256)  # little-endian bit order
+    return le[254::-1]  # 254 .. 0
+
+
+def scalar_mult(scalar_u8, points_u8):
+    """Batched X25519: scalar [32] u8 (pre-clamped), points [N, 32] u8 ->
+    (out [N, 32] u8, nonzero [N] bool).
+
+    `nonzero` is False for lanes whose shared secret is all zero — the
+    small-order-point rejection RFC 7748 §6.1 requires of DH users."""
+    x1 = _decode_u_coords(points_u8)
+    n = x1.shape[1]
+    one = jnp.zeros((8, n), dtype=_U32).at[0].set(_U32(1))
+    zero = jnp.zeros((8, n), dtype=_U32)
+    bits = _scalar_bits(scalar_u8)
+
+    # Ladder with deferred swap (RFC 7748 §5 pseudocode): swap state folds
+    # into the next step; one final conditional swap after the loop.
+    def step(carry, k_t):
+        x2, z2, x3, z3, swap = carry
+        swap = swap ^ k_t
+        do = (swap == _U32(1))
+        x2, x3 = f.select(do, x3, x2), f.select(do, x2, x3)
+        z2, z3 = f.select(do, z3, z2), f.select(do, z2, z3)
+        swap = k_t
+        a = f.add(x2, z2)
+        aa = _sq(a)
+        b = f.sub(x2, z2)
+        bb = _sq(b)
+        e = f.sub(aa, bb)
+        c = f.add(x3, z3)
+        d = f.sub(x3, z3)
+        da = f.mul(d, a)
+        cb = f.mul(c, b)
+        x3n = _sq(f.add(da, cb))
+        z3n = f.mul(x1, _sq(f.sub(da, cb)))
+        x2n = f.mul(aa, bb)
+        z2n = f.mul(e, f.add(aa, f.mul_const(e, _A24)))
+        return (x2n, z2n, x3n, z3n, swap), None
+
+    init = (one, zero, x1, one, _U32(0))
+    (x2, z2, x3, z3, swap), _ = lax.scan(step, init, bits)
+    do = (swap == _U32(1))
+    x2 = f.select(do, x3, x2)
+    z2 = f.select(do, z3, z2)
+
+    out = f.mul(x2, _invert(z2))
+    nonzero = jnp.any(out != _U32(0), axis=0)
+    return _encode_u_coords(out), nonzero

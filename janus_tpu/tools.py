@@ -173,11 +173,25 @@ def cmd_collect(args) -> int:
     )
     from janus_tpu.models import VdafInstance
 
-    keypair = HpkeKeypair(HpkeConfig.decode(_unb64(args.hpke_config)),
-                          _unb64(args.hpke_private_key))
+    if args.collector_credential_file:
+        from janus_tpu.collector import PrivateCollectorCredential
+
+        with open(args.collector_credential_file) as f:
+            cred = PrivateCollectorCredential.from_json(f.read())
+        keypair = cred.hpke_keypair()
+        token = cred.authentication_token()
+    else:
+        if not (args.hpke_config and args.hpke_private_key
+                and args.authorization_bearer_token):
+            print("collect: pass --collector-credential-file OR all of "
+                  "--hpke-config/--hpke-private-key/"
+                  "--authorization-bearer-token", file=sys.stderr)
+            return 2
+        keypair = HpkeKeypair(HpkeConfig.decode(_unb64(args.hpke_config)),
+                              _unb64(args.hpke_private_key))
+        token = AuthenticationToken.bearer(args.authorization_bearer_token)
     collector = Collector(
-        TaskId.from_str(args.task_id), args.leader,
-        AuthenticationToken.bearer(args.authorization_bearer_token),
+        TaskId.from_str(args.task_id), args.leader, token,
         keypair, VdafInstance.from_json_obj(json.loads(args.vdaf)))
     if args.batch_interval_start is not None:
         query = Query.time_interval(Interval(
@@ -228,9 +242,12 @@ def main(argv=None) -> int:
     p.add_argument("--task-id", required=True)
     p.add_argument("--leader", required=True)
     p.add_argument("--vdaf", required=True, help='JSON, e.g. \'"Prio3Count"\' or \'{"Prio3Sum": {"bits": 8}}\'')
-    p.add_argument("--authorization-bearer-token", required=True)
-    p.add_argument("--hpke-config", required=True)
-    p.add_argument("--hpke-private-key", required=True)
+    p.add_argument("--collector-credential-file",
+                   help="PrivateCollectorCredential JSON (replaces the three"
+                        " options below; reference collector credential.rs)")
+    p.add_argument("--authorization-bearer-token")
+    p.add_argument("--hpke-config")
+    p.add_argument("--hpke-private-key")
     p.add_argument("--batch-interval-start", type=int)
     p.add_argument("--batch-interval-duration", type=int)
     p.add_argument("--batch-id")

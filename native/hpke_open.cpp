@@ -90,7 +90,7 @@ static bool labeled_extract(const uint8_t* salt, size_t salt_len,
     memcpy(msg + off, HPKE_V1, 7); off += 7;
     memcpy(msg + off, suite, suite_len); off += suite_len;
     memcpy(msg + off, label, label_len); off += label_len;
-    memcpy(msg + off, ikm, ikm_len); off += ikm_len;
+    if (ikm_len) { memcpy(msg + off, ikm, ikm_len); off += ikm_len; }
     return hmac256(salt, salt_len, msg, off, out);
 }
 
@@ -110,7 +110,7 @@ static bool labeled_expand(const uint8_t* prk, const uint8_t* suite,
     memcpy(msg + off, HPKE_V1, 7); off += 7;
     memcpy(msg + off, suite, suite_len); off += suite_len;
     memcpy(msg + off, label, label_len); off += label_len;
-    memcpy(msg + off, info, info_len); off += info_len;
+    if (info_len) { memcpy(msg + off, info, info_len); off += info_len; }
     msg[off++] = 1;  // T(1) counter
     uint8_t t[32];
     if (!hmac256(prk, 32, msg, off, t)) return false;
@@ -118,20 +118,18 @@ static bool labeled_expand(const uint8_t* prk, const uint8_t* suite,
     return true;
 }
 
-// X25519 with the recipient private key hoisted out of the batch loop
-// (EVP_PKEY parse/alloc per lane costs as much as the scalar mult).
-static bool x25519_with(EVP_PKEY* priv, const uint8_t* pk, uint8_t* dh) {
+// X25519 with the recipient private key AND the derive ctx hoisted out of
+// the batch loop (EVP_PKEY_CTX alloc + derive_init per lane costs ~1/4 of
+// the scalar mult; set_peer swaps the peer on a live ctx).
+static bool x25519_with(EVP_PKEY_CTX* ctx, const uint8_t* pk, uint8_t* dh) {
     bool ok = false;
     EVP_PKEY* peer = EVP_PKEY_new_raw_public_key(EVP_PKEY_X25519_ID, nullptr,
                                                  pk, 32);
-    EVP_PKEY_CTX* ctx = priv ? EVP_PKEY_CTX_new(priv, nullptr) : nullptr;
     size_t len = 32;
-    if (priv && peer && ctx
-        && EVP_PKEY_derive_init(ctx) == 1
+    if (ctx && peer
         && EVP_PKEY_derive_set_peer(ctx, peer) == 1
         && EVP_PKEY_derive(ctx, dh, &len) == 1 && len == 32)
         ok = true;
-    if (ctx) EVP_PKEY_CTX_free(ctx);
     if (peer) EVP_PKEY_free(peer);
     // RFC 7748: all-zero shared secret (small-order point) must be rejected
     if (ok) {
@@ -206,12 +204,32 @@ long hpke_open_batch(long n, const uint8_t* sk_r, const uint8_t* pk_r,
     out_offs[0] = 0;
     EVP_PKEY* priv = EVP_PKEY_new_raw_private_key(EVP_PKEY_X25519_ID, nullptr,
                                                   sk_r, 32);
+    EVP_PKEY_CTX* dctx = priv ? EVP_PKEY_CTX_new(priv, nullptr) : nullptr;
+    if (dctx && EVP_PKEY_derive_init(dctx) != 1) {
+        EVP_PKEY_CTX_free(dctx);
+        dctx = nullptr;
+    }
+    // psk_id_hash / info_hash / key-schedule context are batch constants
+    // (info is shared); hoist them out of the lane loop.
+    uint8_t psk_id_hash_c[32], info_hash_c[32];
+    uint8_t context_c[65];
+    bool sched_ok =
+        labeled_extract(nullptr, 0, suite, 10, "psk_id_hash", nullptr, 0,
+                        psk_id_hash_c)
+        && labeled_extract(nullptr, 0, suite, 10, "info_hash", info,
+                           (size_t)info_len, info_hash_c);
+    context_c[0] = 0;  // mode_base
+    if (sched_ok) {
+        memcpy(context_c + 1, psk_id_hash_c, 32);
+        memcpy(context_c + 33, info_hash_c, 32);
+    }
     for (long i = 0; i < n; ++i) {
         status[i] = 0;
         out_offs[i + 1] = out_off;
+        if (!sched_ok) continue;
         const uint8_t* enc = encs + i * 32;
         uint8_t dh[32];
-        if (!x25519_with(priv, enc, dh)) continue;
+        if (!x25519_with(dctx, enc, dh)) continue;
         // shared_secret = LabeledExpand(LabeledExtract("", "eae_prk", dh),
         //                               "shared_secret", enc || pk_r, 32)
         uint8_t eae_prk[32], shared[32];
@@ -223,22 +241,14 @@ long hpke_open_batch(long n, const uint8_t* sk_r, const uint8_t* pk_r,
             || !labeled_expand(eae_prk, kem_suite, 5, "shared_secret",
                                kem_context, 64, 32, shared))
             continue;
-        // key schedule (mode_base)
-        uint8_t psk_id_hash[32], info_hash[32], secret[32];
-        uint8_t context[65];
+        // key schedule (mode_base); context hoisted above
+        uint8_t secret[32];
         uint8_t key[32], nonce[12];
-        if (!labeled_extract(nullptr, 0, suite, 10, "psk_id_hash", nullptr, 0,
-                             psk_id_hash)
-            || !labeled_extract(nullptr, 0, suite, 10, "info_hash", info,
-                                (size_t)info_len, info_hash))
-            continue;
-        context[0] = 0;  // mode_base
-        memcpy(context + 1, psk_id_hash, 32);
-        memcpy(context + 33, info_hash, 32);
         if (!labeled_extract(shared, 32, suite, 10, "secret", nullptr, 0,
                              secret)
-            || !labeled_expand(secret, suite, 10, "key", context, 65, nk, key)
-            || !labeled_expand(secret, suite, 10, "base_nonce", context, 65,
+            || !labeled_expand(secret, suite, 10, "key", context_c, 65, nk,
+                               key)
+            || !labeled_expand(secret, suite, 10, "base_nonce", context_c, 65,
                                12, nonce))
             continue;
         // seq-0 nonce == base nonce; open
@@ -254,6 +264,7 @@ long hpke_open_batch(long n, const uint8_t* sk_r, const uint8_t* pk_r,
         out_offs[i + 1] = out_off;
         status[i] = 1;
     }
+    if (dctx) EVP_PKEY_CTX_free(dctx);
     if (priv) EVP_PKEY_free(priv);
     return out_off;
 }

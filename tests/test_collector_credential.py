@@ -1,0 +1,104 @@
+"""PrivateCollectorCredential JSON format + CORS preflight routes."""
+
+import json
+
+
+# Sample credential in the ecosystem JSON format (transcribed from the
+# reference's serde test fixture, collector/src/credential.rs:58 — the
+# format IS the compatibility contract being pinned here).
+SAMPLE = """{
+  "aead": "AesGcm128",
+  "id": 66,
+  "kdf": "Sha256",
+  "kem": "X25519HkdfSha256",
+  "private_key": "uKkTvzKLfYNUPZcoKI7hV64zS06OWgBkbivBL4Sw4mo",
+  "public_key": "CcDghts2boltt9GQtBUxdUsVR83SCVYHikcGh33aVlU",
+  "token": "Krx-CLfdWo1ULAfsxhr0rA"
+}
+"""
+
+
+def test_credential_parses_ecosystem_json():
+    import base64
+
+    from janus_tpu.collector import PrivateCollectorCredential
+    from janus_tpu.messages import HpkeAeadId, HpkeKdfId, HpkeKemId
+
+    cred = PrivateCollectorCredential.from_json(SAMPLE)
+    kp = cred.hpke_keypair()
+    assert kp.config.id.value == 66
+    assert kp.config.kem_id.code == HpkeKemId.X25519_HKDF_SHA256.code
+    assert kp.config.kdf_id.code == HpkeKdfId.HKDF_SHA256.code
+    assert kp.config.aead_id.code == HpkeAeadId.AES_128_GCM.code
+    assert kp.config.public_key.data == base64.urlsafe_b64decode(
+        "CcDghts2boltt9GQtBUxdUsVR83SCVYHikcGh33aVlU=")
+    assert kp.private_key == base64.urlsafe_b64decode(
+        "uKkTvzKLfYNUPZcoKI7hV64zS06OWgBkbivBL4Sw4mo=")
+    tok = cred.authentication_token()
+    assert tok.token == "Krx-CLfdWo1ULAfsxhr0rA"
+    assert tok.token_type == "Bearer"
+
+
+def test_credential_roundtrip():
+    from janus_tpu.collector import PrivateCollectorCredential
+
+    cred = PrivateCollectorCredential.from_json(SAMPLE)
+    again = PrivateCollectorCredential.from_json(cred.to_json())
+    assert again == cred
+    # canonical key order survives (sorted like the ecosystem emits)
+    assert json.loads(cred.to_json()) == json.loads(SAMPLE)
+
+
+def test_collect_tool_reads_credential(tmp_path):
+    """The collect CLI accepts --collector-credential-file (reference
+    tools collect --collector-credential-file)."""
+    from janus_tpu import tools
+
+    path = tmp_path / "cred.json"
+    path.write_text(SAMPLE)
+    # No leader is running: the tool must get far enough to fail on the
+    # network, proving the credential parsed and wired in.
+    rc = None
+    try:
+        rc = tools.main([
+            "collect", "--task-id", "A" * 43, "--leader",
+            "http://127.0.0.1:1", "--vdaf", '"Prio3Count"',
+            "--collector-credential-file", str(path),
+            "--batch-interval-start", "0",
+            "--batch-interval-duration", "3600",
+            "--timeout", "1",
+        ])
+    except Exception as e:
+        assert "Connection" in type(e).__name__ or "connect" in str(e).lower()
+    else:
+        assert rc != 0
+
+
+def test_cors_preflight_routes():
+    """OPTIONS preflights for hpke_config and upload (reference
+    http_handlers.rs:391,429); no CORS on aggregator-to-aggregator routes."""
+    from janus_tpu.aggregator.http_handlers import DapRouter
+
+    router = DapRouter(aggregator=None)  # preflights never touch it
+    r = router.handle("OPTIONS", "/hpke_config", {}, b"",
+                      {"Origin": "https://example.com"})
+    assert r.status == 204
+    assert r.headers["Access-Control-Allow-Origin"] == "https://example.com"
+    assert r.headers["Access-Control-Allow-Methods"] == "GET"
+    assert r.headers["Access-Control-Max-Age"] == "86400"
+
+    r = router.handle("OPTIONS", "/tasks/x/reports", {}, b"",
+                      {"Origin": "https://example.com"})
+    assert r.status == 204
+    assert r.headers["Access-Control-Allow-Methods"] == "PUT"
+    assert r.headers["Access-Control-Allow-Headers"] == "content-type"
+
+    # no Origin header -> not a CORS request, no CORS headers
+    r = router.handle("OPTIONS", "/hpke_config", {}, b"", {})
+    assert r.status == 204
+    assert "Access-Control-Allow-Origin" not in r.headers
+
+    # aggregator-to-aggregator surface: no preflight route at all
+    r = router.handle("OPTIONS", "/tasks/x/aggregation_jobs/y", {}, b"",
+                      {"Origin": "https://example.com"})
+    assert r.status == 404
