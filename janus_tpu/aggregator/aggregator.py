@@ -905,6 +905,41 @@ class Aggregator:
         if logic.descriptor is not TIME_INTERVAL:
             fixed_ident = pbs.batch_identifier
 
+        # Batch identifiers are a pure function of the request: compute the
+        # grouping BEFORE the transaction and pre-launch each group's masked
+        # aggregate on device — the reduce + transfer then overlaps the
+        # transaction's own writes, and the tx only re-reduces groups whose
+        # finished set was changed by replay/collected flips.
+        if fixed_ident is None:
+            buckets = [t - t % precision for t in times]
+            ident_of = {
+                b: Interval(Time(b), task.time_precision)
+                for b in set(buckets)
+            }
+            by_ident: dict = {}
+            for i, b in enumerate(buckets):
+                by_ident.setdefault(b, []).append(i)
+        else:
+            ident_of = {0: fixed_ident}
+            by_ident = {0: list(range(n))}
+        import numpy as _np
+
+        pre_agg: dict = {}
+        for key, group in by_ident.items():
+            fin0 = [i for i in group if kinds0[i] == 0]
+            if not fin0:
+                continue
+            first = fin_dev0[fin0[0]][0] if fin_dev0[fin0[0]] else None
+            if first is None or not all(
+                    fin_dev0[i] is not None and fin_dev0[i][0] is first
+                    for i in fin0):
+                continue  # mixed/host-fallback lanes: reduce inside the tx
+            mask = _np.zeros(first.shape[-1], dtype=bool)
+            for i in fin0:
+                mask[fin_dev0[i][1]] = True
+            pre_agg[key] = (frozenset(fin0),
+                            engine.aggregate_masked_launch(first, mask))
+
         def txn(tx):
             existing = tx.get_aggregation_job(task_id, job_id)
             if existing is not None:
@@ -928,10 +963,20 @@ class Aggregator:
             fin_dev = list(fin_dev0)
             fin_raw = list(fin_raw0)
 
+            _tt0 = _time.monotonic()
+
+            def _tmark(name: str) -> None:
+                nonlocal _tt0
+                now = _time.monotonic()
+                t_phase[name] = t_phase.get(name, 0.0) + (now - _tt0)
+                _tt0 = now
+
             tx.put_scrubbed_reports_batch(
                 task_id, list(zip(ids, times)))
+            _tmark("tx_scrub")
             replayed = tx.check_reports_replayed_batch(
                 task_id, ids, job_id, agg_param)
+            _tmark("tx_replay")
             REPLAYED = int(PrepareError.REPORT_REPLAYED)
             if replayed:
                 for i in range(n):
@@ -941,21 +986,8 @@ class Aggregator:
                         resp_msgs[i] = b""
                         fin_dev[i] = fin_raw[i] = None
 
-            # batch identifiers (TIME_INTERVAL: per-report bucket;
-            # FIXED_SIZE: the request's batch id), then the collected-batch
-            # gate per touched identifier
-            if fixed_ident is None:
-                buckets = [t - t % precision for t in times]
-                ident_of = {
-                    b: Interval(Time(b), task.time_precision)
-                    for b in set(buckets)
-                }
-                by_ident = {}
-                for i, b in enumerate(buckets):
-                    by_ident.setdefault(b, []).append(i)
-            else:
-                ident_of = {0: fixed_ident}
-                by_ident = {0: list(range(n))}
+            # collected-batch gate per touched identifier (the identifier
+            # grouping itself was computed pre-tx)
             COLLECTED = int(PrepareError.BATCH_COLLECTED)
             for key in sorted(ident_of):
                 shards = tx.get_batch_aggregations(
@@ -1001,7 +1033,9 @@ class Aggregator:
                                  None, None, None, None, None, None,
                                  errors[i], resp_b))
                 resp_parts.append(resp_b)
+            _tmark("tx_rows_build")
             tx.put_report_aggregations_rows(rows)
+            _tmark("tx_insert")
 
             # per-identifier accumulation into one random shard
             writer = AggregationJobWriter(
@@ -1022,9 +1056,16 @@ class Aggregator:
                     for i in fin:
                         checksum = checksum.updated_with(ReportId(ids[i]))
                 if fin:
-                    delta_share = self._aggregate_columnar(
-                        engine, [fin_dev[i] for i in fin],
-                        [fin_raw[i] for i in fin])
+                    pre = pre_agg.get(key)
+                    if pre is not None and pre[0] == frozenset(fin):
+                        # the finished set survived replay/collected checks:
+                        # the device reduce launched pre-tx is (probably
+                        # already) done — just materialize it
+                        delta_share = engine.aggregate_resolve(pre[1])
+                    else:
+                        delta_share = self._aggregate_columnar(
+                            engine, [fin_dev[i] for i in fin],
+                            [fin_raw[i] for i in fin])
                     flo = min(times[i] for i in fin)
                     fhi = max(times[i] for i in fin)
                     interval = Interval(Time(flo), Duration(fhi - flo + 1))
@@ -1038,6 +1079,7 @@ class Aggregator:
                     count, interval, checksum, created_delta=1,
                     terminated_delta=1)
 
+            _tmark("tx_accumulate")
             total = sum(len(p) for p in resp_parts)
             return pk(">I", total) + b"".join(resp_parts)
 

@@ -816,16 +816,27 @@ class BatchPrio3:
         mask[:K] = True
         return self.aggregate_masked(arr, mask)
 
-    def aggregate_masked(self, shares, mask) -> list[int]:
-        """Masked modular sum over the report axis, entirely on device:
-        `shares` may be the engine's resident [L, OUTPUT_LEN, M] batch array,
-        so only the [L, OUTPUT_LEN] result crosses to the host."""
+    def aggregate_masked_launch(self, shares, mask):
+        """Dispatch the masked modular sum WITHOUT materializing: returns
+        the async on-device [L, OUT] value.  Callers that know the mask
+        early (the columnar init path launches before opening its datastore
+        transaction) overlap the reduce + transfer with host work and
+        materialize later via aggregate_resolve."""
         if self._agg_fn is None:
             from janus_tpu.parallel import aggregate_fn
 
             self._agg_fn = aggregate_fn(self.f, self.mesh)
-        res = np.asarray(self._agg_fn(shares, np.asarray(mask)))  # [L, OUT]
+        return self._agg_fn(shares, np.asarray(mask))
+
+    def aggregate_resolve(self, handle) -> list[int]:
+        res = np.asarray(handle)  # [L, OUT]
         return self._raw_to_ints(res.T)
+
+    def aggregate_masked(self, shares, mask) -> list[int]:
+        """Masked modular sum over the report axis, entirely on device:
+        `shares` may be the engine's resident [L, OUTPUT_LEN, M] batch array,
+        so only the [L, OUTPUT_LEN] result crosses to the host."""
+        return self.aggregate_resolve(self.aggregate_masked_launch(shares, mask))
 
     # -- limb conversion helpers ------------------------------------------
 

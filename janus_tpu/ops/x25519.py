@@ -87,8 +87,40 @@ def _pow2k(x, k: int):
 
 
 def _invert(z):
-    """z^(p-2) mod p: the standard 2^255-21 addition chain (11 mults +
-    254 squarings), as in every public curve25519 implementation."""
+    """z^(p-2) mod p.
+
+    Two equivalent forms, chosen by backend at trace time:
+    - TPU: the standard 2^255-21 addition chain (11 mults + 254 squarings)
+      — runtime-optimal, but its ~13 distinct scan bodies cost minutes of
+      XLA:CPU compile.
+    - CPU (the test/virtual-mesh platform): one square-and-multiply scan
+      over the exponent bits — ~2x the multiplies but a single small scan
+      body, keeping cold-suite compiles bounded.
+    Both paths are pinned by the same RFC 7748 vectors."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return _invert_scan(z)
+    return _invert_chain(z)
+
+
+def _invert_scan(z):
+    e = f.MODULUS - 2
+    bits = jnp.asarray([(e >> i) & 1 for i in range(254, -1, -1)],
+                       dtype=jnp.uint32)
+
+    def step(acc, b):
+        sq = _sq(acc)
+        withz = f.mul(sq, z)
+        return f.select(jnp.broadcast_to(b == _U32(1), sq.shape[1:]),
+                        withz, sq), None
+
+    one = jnp.zeros_like(z).at[0].set(_U32(1))
+    acc, _ = lax.scan(step, one, bits)
+    return acc
+
+
+def _invert_chain(z):
     z2 = _sq(z)                                   # 2^1
     z9 = f.mul(_pow2k(z2, 2), z)                  # 2^3 + 1 = 9
     z11 = f.mul(z9, z2)                           # 11
