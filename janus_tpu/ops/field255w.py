@@ -1,0 +1,256 @@
+"""GF(2^255-19) in a vectorized "wide" radix-2^15 representation.
+
+`ops/field255.py` models an element as 8 uint32 limbs and builds every
+field op out of ~1000 per-limb scalar JAX ops (64 32x32 partial products,
+each with its own carry compares).  That graph shape is hostile to the TPU
+VPU: XLA materializes hundreds of tiny fusions, and a 255-step Montgomery
+ladder pays the per-fusion overhead 255 times — measured ~90 ms of fixed
+overhead per ladder launch plus ~20 us/lane, an order of magnitude off the
+VPU roofline.
+
+This module is the TPU-shaped alternative used by the hot kernels
+(`ops/x25519.py` decap ladder, the Poplar1 leaf sketch):
+
+- An element is a uint32 array [17, N] of 15-bit limbs (255 = 17*15, so
+  the pseudo-Mersenne fold lands exactly on the limb boundary and the
+  fold multiplier is 19, not 38).
+- `mul` is ONE [17, 17, N] outer product (16-bit limbs square inside
+  uint32 exactly), a lo/hi split, and an anti-diagonal pad-stack
+  reduction — a handful of large tensor ops instead of ~1000 scalar ones.
+- add/sub are LAZY single vector ops (no carry chains); `carry` is the
+  explicit 2-pass normalization, and the domain discipline is:
+  mul/sq inputs must have limbs < 2^16 (one lazy add's worth of slack),
+  which every op here re-establishes on its outputs.
+
+Reference behavior covered: the prio crate's Field255 arithmetic consumed
+by the reference at core/src/vdaf.rs:94 (Poplar1 leaf), and the X25519
+decap of aggregator/src/aggregator.rs:1772's per-report HPKE open.
+Bit-exactness is pinned against ops/field255 (itself pinned against the
+host oracle) in tests/test_field255w.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+MODULUS = (1 << 255) - 19
+LIMBS = 17
+RADIX = 15
+
+_U32 = jnp.uint32
+_MASK = jnp.uint32((1 << RADIX) - 1)
+_NINETEEN = jnp.uint32(19)
+
+_P_WIDE_INT = tuple((MODULUS >> (RADIX * i)) & ((1 << RADIX) - 1)
+                    for i in range(LIMBS))
+# 2p limb-wise with borrow headroom: K_i chosen so that K - y never
+# underflows limb-wise for any y with limbs < 2^17 (lazy inputs), and
+# K == 2p (mod p).  K_i = 2*p_i + 2^17 - (borrow to limb i+1) pattern:
+# use K = 4p whose limbs (in this radix) are all >= 2^17 - small; simpler
+# and provably safe: K_i = 4*p_i >= 4*(2^15 - 19) > 2^17 - 76 for limb 0.
+# Limb 0 of p is 2^15 - 19 so 4*p_0 = 2^17 - 76; a lazy y_0 < 2^17 can
+# exceed it.  Take K = 8p instead: every limb >= 2^18 - 152 > 2^17. 8p is
+# still a multiple of p so the result is unchanged mod p.
+_K_SUB_INT = tuple(8 * p for p in _P_WIDE_INT)
+
+
+def _np_wide(value: int) -> np.ndarray:
+    return np.array([(value >> (RADIX * i)) & ((1 << RADIX) - 1)
+                     for i in range(LIMBS)], dtype=np.uint32)
+
+
+def zeros(n: int) -> jnp.ndarray:
+    return jnp.zeros((LIMBS, n), dtype=_U32)
+
+
+def const(value: int, n: int) -> jnp.ndarray:
+    return jnp.broadcast_to(
+        jnp.asarray(_np_wide(value % MODULUS))[:, None], (LIMBS, n))
+
+
+# ---------------------------------------------------------------------------
+# lazy arithmetic
+# ---------------------------------------------------------------------------
+
+
+def _carry1(x):
+    """One shift-fold pass.  For inputs with limbs < 2^17 the output's
+    limbs are < 2^15 + 40 — strictly mul-safe."""
+    hi = x >> RADIX
+    return (x & _MASK) + jnp.concatenate(
+        [(hi[-1:] * _NINETEEN), hi[:-1]], axis=0)
+
+
+def add(x, y):
+    """Add with a single fold pass: carried inputs (limbs < 2^15 + eps)
+    give a mul-safe output.  Two carried values can sum to just over
+    2^16 - 1, whose square would overflow uint32 — hence the fold."""
+    return _carry1(x + y)
+
+
+def sub(x, y):
+    """Lazy subtract via the donna trick: x + (8p - y) keeps every limb
+    non-negative for y limbs < 2^17; result limbs < 2^18 + 2^17, so a
+    `carry` MUST follow before the value feeds a mul.  `sub_c` does both."""
+    k = jnp.asarray(np.array(_K_SUB_INT, dtype=np.uint32))[:, None]
+    return x + (k - y)
+
+
+def carry(x):
+    """Two shift-fold passes: limbs -> < 2^15 + 2 (valid mul input).
+
+    Works for any x with limbs < 2^28 (mul/fold outputs, lazy add/sub
+    outputs).  The carry out of the top limb re-enters at limb 0 times 19
+    (2^255 === 19 mod p)."""
+    for _ in range(2):
+        hi = x >> RADIX
+        x = (x & _MASK) + jnp.concatenate(
+            [(hi[-1:] * _NINETEEN), hi[:-1]], axis=0)
+    return x
+
+
+def sub_c(x, y):
+    return carry(sub(x, y))
+
+
+_PAD_WIDTH = 2 * LIMBS - 1  # 33 product limbs
+
+
+def _antidiag(p):
+    """[17, 17, N] -> [33, N]: out[k] = sum_{i+j=k} p[i, j].
+
+    Implemented as 17 shifted pads + one stacked sum — a few big tensor
+    ops, no gathers."""
+    rows = [jnp.pad(p[i], ((i, _PAD_WIDTH - LIMBS - i), (0, 0)))
+            for i in range(LIMBS)]
+    return jnp.sum(jnp.stack(rows, axis=0), axis=0)
+
+
+def mul(x, y):
+    """Field multiply.  Inputs: limbs < 2^16 (canonical or one lazy add).
+    Output: carried (limbs < 2^15 + 2)."""
+    n = x.shape[-1]
+    p = x[:, None, :] * y[None, :, :]          # [17,17,N], exact in u32
+    lo = p & _MASK
+    hi = p >> RADIX
+    slo = _antidiag(lo)                        # [33,N], < 17 * 2^15 < 2^20
+    shi = _antidiag(hi)                        # [33,N], < 17 * 2^17 < 2^22
+    # the product spans 34 limbs (510 bits): slo at limbs 0..32, shi
+    # shifted up one limb at 1..33
+    t = (jnp.concatenate([slo, jnp.zeros((1, n), _U32)], axis=0)
+         + jnp.concatenate([jnp.zeros((1, n), _U32), shi], axis=0))
+    # fold limbs 17..33 (weight 2^255 * 2^(15(k-17))) back by *19
+    low, high = t[:LIMBS], t[LIMBS:]
+    return carry(low + high * _NINETEEN)       # < 2^23 + 19*2^23 < 2^28
+
+
+def sq(x):
+    return mul(x, x)
+
+
+def mul_small(x, c: int):
+    """Multiply by a constant c < 2^26 (covers the ladder's a24=121665).
+    Input limbs < 2^16.  c splits at the radix: x*c = x*c0 + (x*c1)<<15,
+    the shifted part re-entering limb 0 *19 at the top — that fold term
+    u[16]*19 < c1 * 2^16 * 19 must stay below 2^32, bounding c1 < 2^11."""
+    assert 0 <= c < (1 << 26)
+    c0, c1 = c & ((1 << RADIX) - 1), c >> RADIX
+    t = x * _U32(c0) if c0 else jnp.zeros_like(x)  # < 2^31
+    if c1:
+        u = x * _U32(c1)                           # < 2^31 for c1 < 2^15
+        t = t + jnp.concatenate(                   # shift one limb up
+            [u[-1:] * _NINETEEN, u[:-1]], axis=0)
+    return carry(t)
+
+
+def select(cond, a, b):
+    """Per-lane select: cond [N] (or scalar) broadcasts over limbs."""
+    return jnp.where(cond, a, b)
+
+
+# ---------------------------------------------------------------------------
+# canonicalization / io
+# ---------------------------------------------------------------------------
+
+
+def _seq_carry(x):
+    """One exact sequential carry pass; the top carry folds back *19.
+    For inputs with limbs < 2^16 the result has limbs < 2^15 except
+    possibly limb 0/1 by a few bits; two passes fully normalize."""
+    outs = []
+    c = jnp.zeros_like(x[0])
+    for i in range(LIMBS):
+        v = x[i] + c
+        outs.append(v & _MASK)
+        c = v >> RADIX
+    out = jnp.stack(outs, axis=0)
+    return out.at[0].add(c * _NINETEEN)
+
+
+def canonical(x):
+    """Full reduction to the canonical representative (< p), e.g. before
+    encoding.  Input: any carried value (limbs < 2^16)."""
+    x = carry(x)
+    x = _seq_carry(_seq_carry(_seq_carry(x)))
+    # x < 2^255 with limbs < 2^15; at most one subtract of p remains
+    # (values in [p, 2^255) include the non-canonical 2^255-19..2^255-1
+    # range RFC 7748 decoding admits).
+    p = jnp.asarray(np.array(_P_WIDE_INT, dtype=np.uint32))
+    d_out = []
+    borrow = jnp.zeros_like(x[0])
+    for i in range(LIMBS):
+        need_i = p[i] + borrow
+        d = (x[i] | _U32(1 << 20)) - need_i  # force no u32 wrap; bit 20
+        borrow = _U32(1) - (d >> 20)         # borrow iff x[i] < need_i
+        d_out.append(d & _MASK)
+    d_stack = jnp.stack(d_out, axis=0)
+    # borrow == 0  <=>  x >= p
+    return jnp.where(borrow == 0, d_stack, x)
+
+
+def from_bytes_le(b_u8):
+    """[N, 32] u8 little-endian (top bit ignored) -> wide limbs [17, N]."""
+    n = b_u8.shape[0]
+    bits = ((b_u8[:, :, None].astype(_U32)
+             >> jnp.arange(8, dtype=_U32)[None, None, :]) & _U32(1))
+    bits = bits.reshape(n, 256)[:, :255]           # drop bit 255
+    w = bits.reshape(n, LIMBS, RADIX) * (
+        _U32(1) << jnp.arange(RADIX, dtype=_U32))[None, None, :]
+    return jnp.sum(w, axis=-1).T                   # [17, N]
+
+
+def to_bytes_le(x):
+    """Canonical wide limbs [17, N] -> [N, 32] u8 little-endian."""
+    n = x.shape[-1]
+    limbs = x.T                                    # [N, 17]
+    bits = ((limbs[:, :, None] >> jnp.arange(RADIX, dtype=_U32)[None, None, :])
+            & _U32(1)).reshape(n, 255)
+    bits = jnp.concatenate([bits, jnp.zeros((n, 1), _U32)], axis=-1)
+    by = bits.reshape(n, 32, 8) * (
+        _U32(1) << jnp.arange(8, dtype=_U32))[None, None, :]
+    return jnp.sum(by, axis=-1).astype(jnp.uint8)
+
+
+def from_std(x8):
+    """ops/field255 [8, N] u32 standard limbs -> wide [17, N].
+
+    Splits each 32-bit limb into bit-ranges; exact for canonical inputs."""
+    n = x8.shape[-1]
+    bits = ((x8[:, None, :] >> jnp.arange(32, dtype=_U32)[None, :, None])
+            & _U32(1))                             # [8, 32, N]
+    bits = bits.reshape(256, n)[:255]
+    w = bits.reshape(LIMBS, RADIX, n) * (
+        _U32(1) << jnp.arange(RADIX, dtype=_U32))[None, :, None]
+    return jnp.sum(w, axis=1)
+
+
+def to_std(x):
+    """Canonical wide [17, N] -> ops/field255 [8, N] u32 standard limbs."""
+    n = x.shape[-1]
+    bits = ((x[:, None, :] >> jnp.arange(RADIX, dtype=_U32)[None, :, None])
+            & _U32(1)).reshape(255, n)
+    bits = jnp.concatenate([bits, jnp.zeros((1, n), _U32)], axis=0)
+    w = bits.reshape(8, 32, n) * (
+        _U32(1) << jnp.arange(32, dtype=_U32))[None, :, None]
+    return jnp.sum(w, axis=1)

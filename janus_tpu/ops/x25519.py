@@ -1,4 +1,4 @@
-"""Batched X25519 (RFC 7748) on device, over janus_tpu.ops.field255.
+"""Batched X25519 (RFC 7748) on device, over janus_tpu.ops.field255w.
 
 Why this exists: the helper's aggregate-init handler must HPKE-open every
 report share (reference aggregator/src/aggregator.rs:1772, one
@@ -9,9 +9,10 @@ thousand ladders run as one vectorized program while the host stages the
 next pipeline phase.  (SURVEY.md §2.8's "crypto plane on device" P1 taken
 one layer further than the VDAF math.)
 
-Shape/layout contract (matches field255): a batch of field elements is a
-uint32 array [8, N] (limb-leading, batch-minor).  Public API works on byte
-arrays: points/outputs are [N, 32] uint8 little-endian as on the wire.
+Shape/layout contract: the ladder state lives in the wide radix-2^15
+field (uint32 [17, N], limb-leading, batch-minor — see ops/field255w).
+Public API works on byte arrays: points/outputs are [N, 32] uint8
+little-endian as on the wire.
 
 The scalar (recipient private key) is ONE key for the whole batch — the
 DAP helper opens every report under its own keypair — so the ladder's
@@ -28,13 +29,11 @@ implementation (cryptography's X25519).
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
-from janus_tpu.ops import field255 as f
+from janus_tpu.ops import field255w as fw
 
 _U32 = jnp.uint32
-_U8 = jnp.uint8
 
 _A24 = 121665  # (486662 - 2) / 4
 
@@ -48,93 +47,6 @@ def clamp_scalar(sk: bytes) -> bytes:
     return bytes(b)
 
 
-def _decode_u_coords(points_u8):
-    """[N, 32] u8 little-endian -> [8, N] u32 limbs, canonical (< p).
-
-    RFC 7748: mask the top bit, accept non-canonical values mod p (u is in
-    [0, 2^255), so one conditional subtract canonicalizes)."""
-    pts = points_u8.astype(_U32)  # [N, 32]
-    limbs = (pts[:, 0::4]
-             | (pts[:, 1::4] << _U32(8))
-             | (pts[:, 2::4] << _U32(16))
-             | (pts[:, 3::4] << _U32(24)))  # [N, 8], limb-minor
-    limbs = jnp.transpose(limbs, (1, 0))  # [8, N]
-    limbs = limbs.at[7].set(limbs[7] & _U32(0x7FFFFFFF))  # mask bit 255
-    return f._cond_sub_p([limbs[i] for i in range(8)])
-
-
-def _encode_u_coords(x):
-    """[8, N] u32 canonical limbs -> [N, 32] u8 little-endian."""
-    limbs = jnp.transpose(x, (1, 0))  # [N, 8]
-    bs = [
-        (limbs >> _U32(8 * i)).astype(_U8)[..., None] for i in range(4)
-    ]  # 4 x [N, 8, 1]
-    return jnp.concatenate(bs, axis=-1).reshape(x.shape[1], 32)
-
-
-def _sq(x):
-    return f.mul(x, x)
-
-
-def _pow2k(x, k: int):
-    """x^(2^k): k squarings under lax.scan (compile-size discipline)."""
-
-    def step(c, _):
-        return _sq(c), None
-
-    out, _ = lax.scan(step, x, None, length=k)
-    return out
-
-
-def _invert(z):
-    """z^(p-2) mod p.
-
-    Two equivalent forms, chosen by backend at trace time:
-    - TPU: the standard 2^255-21 addition chain (11 mults + 254 squarings)
-      — runtime-optimal, but its ~13 distinct scan bodies cost minutes of
-      XLA:CPU compile.
-    - CPU (the test/virtual-mesh platform): one square-and-multiply scan
-      over the exponent bits — ~2x the multiplies but a single small scan
-      body, keeping cold-suite compiles bounded.
-    Both paths are pinned by the same RFC 7748 vectors."""
-    import jax
-
-    if jax.default_backend() == "cpu":
-        return _invert_scan(z)
-    return _invert_chain(z)
-
-
-def _invert_scan(z):
-    e = f.MODULUS - 2
-    bits = jnp.asarray([(e >> i) & 1 for i in range(254, -1, -1)],
-                       dtype=jnp.uint32)
-
-    def step(acc, b):
-        sq = _sq(acc)
-        withz = f.mul(sq, z)
-        return f.select(jnp.broadcast_to(b == _U32(1), sq.shape[1:]),
-                        withz, sq), None
-
-    one = jnp.zeros_like(z).at[0].set(_U32(1))
-    acc, _ = lax.scan(step, one, bits)
-    return acc
-
-
-def _invert_chain(z):
-    z2 = _sq(z)                                   # 2^1
-    z9 = f.mul(_pow2k(z2, 2), z)                  # 2^3 + 1 = 9
-    z11 = f.mul(z9, z2)                           # 11
-    z2_5_0 = f.mul(_sq(z11), z9)                  # 2^5 - 2^0
-    z2_10_0 = f.mul(_pow2k(z2_5_0, 5), z2_5_0)    # 2^10 - 2^0
-    z2_20_0 = f.mul(_pow2k(z2_10_0, 10), z2_10_0)
-    z2_40_0 = f.mul(_pow2k(z2_20_0, 20), z2_20_0)
-    z2_50_0 = f.mul(_pow2k(z2_40_0, 10), z2_10_0)
-    z2_100_0 = f.mul(_pow2k(z2_50_0, 50), z2_50_0)
-    z2_200_0 = f.mul(_pow2k(z2_100_0, 100), z2_100_0)
-    z2_250_0 = f.mul(_pow2k(z2_200_0, 50), z2_50_0)
-    return f.mul(_pow2k(z2_250_0, 5), z11)        # 2^255 - 21
-
-
 def _scalar_bits(scalar_u8):
     """[32] u8 clamped scalar -> [255] u32 bits, most significant first
     (bit 254 down to 0; bit 255 is cleared by clamping)."""
@@ -144,48 +56,86 @@ def _scalar_bits(scalar_u8):
     return le[254::-1]  # 254 .. 0
 
 
+def _w_sq(x):
+    return fw.mul(x, x)
+
+
+def _w_pow2k(x, k: int):
+    def step(c, _):
+        return _w_sq(c), None
+
+    out, _ = lax.scan(step, x, None, length=k)
+    return out
+
+
+def _w_invert(z):
+    """z^(p-2): the 2^255-21 addition chain on the wide field.  Each wide
+    mul is ~40 XLA ops (vs ~1000 for the 8-limb form), so the chain's 13
+    scan bodies stay cheap to compile on every backend."""
+    z2 = _w_sq(z)
+    z9 = fw.mul(_w_pow2k(z2, 2), z)
+    z11 = fw.mul(z9, z2)
+    z2_5_0 = fw.mul(_w_sq(z11), z9)
+    z2_10_0 = fw.mul(_w_pow2k(z2_5_0, 5), z2_5_0)
+    z2_20_0 = fw.mul(_w_pow2k(z2_10_0, 10), z2_10_0)
+    z2_40_0 = fw.mul(_w_pow2k(z2_20_0, 20), z2_20_0)
+    z2_50_0 = fw.mul(_w_pow2k(z2_40_0, 10), z2_10_0)
+    z2_100_0 = fw.mul(_w_pow2k(z2_50_0, 50), z2_50_0)
+    z2_200_0 = fw.mul(_w_pow2k(z2_100_0, 100), z2_100_0)
+    z2_250_0 = fw.mul(_w_pow2k(z2_200_0, 50), z2_50_0)
+    return fw.mul(_w_pow2k(z2_250_0, 5), z11)
+
+
 def scalar_mult(scalar_u8, points_u8):
     """Batched X25519: scalar [32] u8 (pre-clamped), points [N, 32] u8 ->
     (out [N, 32] u8, nonzero [N] bool).
 
+    Runs on the wide radix-2^15 field (ops/field255w): the ladder step is
+    a few dozen large tensor ops instead of thousands of per-limb scalar
+    ops, which is what the VPU actually wants — the 8-limb form measured
+    ~90 ms fixed overhead per launch from per-fusion dispatch alone.
+
     `nonzero` is False for lanes whose shared secret is all zero — the
     small-order-point rejection RFC 7748 §6.1 requires of DH users."""
-    x1 = _decode_u_coords(points_u8)
-    n = x1.shape[1]
-    one = jnp.zeros((8, n), dtype=_U32).at[0].set(_U32(1))
-    zero = jnp.zeros((8, n), dtype=_U32)
+    n = points_u8.shape[0]
+    # RFC 7748 decode: mask bit 255, accept non-canonical u in [0, 2^255)
+    x1 = fw.from_bytes_le(points_u8)
+    one = fw.const(1, n)
+    zero = fw.zeros(n)
     bits = _scalar_bits(scalar_u8)
 
     # Ladder with deferred swap (RFC 7748 §5 pseudocode): swap state folds
     # into the next step; one final conditional swap after the loop.
-    def step(carry, k_t):
-        x2, z2, x3, z3, swap = carry
+    # Carry discipline: every state entering a step is carried (< 2^15+e);
+    # fw.add outputs stay mul-safe for one level, fw.sub needs sub_c.
+    def step(carry_st, k_t):
+        x2, z2, x3, z3, swap = carry_st
         swap = swap ^ k_t
         do = (swap == _U32(1))
-        x2, x3 = f.select(do, x3, x2), f.select(do, x2, x3)
-        z2, z3 = f.select(do, z3, z2), f.select(do, z2, z3)
+        x2, x3 = fw.select(do, x3, x2), fw.select(do, x2, x3)
+        z2, z3 = fw.select(do, z3, z2), fw.select(do, z2, z3)
         swap = k_t
-        a = f.add(x2, z2)
-        aa = _sq(a)
-        b = f.sub(x2, z2)
-        bb = _sq(b)
-        e = f.sub(aa, bb)
-        c = f.add(x3, z3)
-        d = f.sub(x3, z3)
-        da = f.mul(d, a)
-        cb = f.mul(c, b)
-        x3n = _sq(f.add(da, cb))
-        z3n = f.mul(x1, _sq(f.sub(da, cb)))
-        x2n = f.mul(aa, bb)
-        z2n = f.mul(e, f.add(aa, f.mul_const(e, _A24)))
+        a = fw.add(x2, z2)
+        aa = _w_sq(a)
+        b = fw.sub_c(x2, z2)
+        bb = _w_sq(b)
+        e = fw.sub_c(aa, bb)
+        c = fw.add(x3, z3)
+        d = fw.sub_c(x3, z3)
+        da = fw.mul(d, a)
+        cb = fw.mul(c, b)
+        x3n = _w_sq(fw.add(da, cb))
+        z3n = fw.mul(x1, _w_sq(fw.sub_c(da, cb)))
+        x2n = fw.mul(aa, bb)
+        z2n = fw.mul(e, fw.add(aa, fw.mul_small(e, _A24)))
         return (x2n, z2n, x3n, z3n, swap), None
 
     init = (one, zero, x1, one, _U32(0))
-    (x2, z2, x3, z3, swap), _ = lax.scan(step, init, bits)
+    (x2, z2, x3, z3, swap), _ = lax.scan(step, init, bits, unroll=2)
     do = (swap == _U32(1))
-    x2 = f.select(do, x3, x2)
-    z2 = f.select(do, z3, z2)
+    x2 = fw.select(do, x3, x2)
+    z2 = fw.select(do, z3, z2)
 
-    out = f.mul(x2, _invert(z2))
+    out = fw.canonical(fw.mul(x2, _w_invert(z2)))
     nonzero = jnp.any(out != _U32(0), axis=0)
-    return _encode_u_coords(out), nonzero
+    return fw.to_bytes_le(out), nonzero

@@ -1,0 +1,106 @@
+"""field255w (wide radix-2^15 GF(2^255-19)) vs exact Python ints.
+
+The wide field backs the X25519 decap ladder (ops/x25519.py) and is the
+TPU-shaped replacement for the per-limb ops/field255 graphs in hot
+kernels.  Reference semantics: the prio crate's Field255 as consumed at
+/root/reference/core/src/vdaf.rs:94; X25519 per RFC 7748.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from janus_tpu.ops import field255w as fw
+
+P = fw.MODULUS
+
+
+def pack(vals):
+    out = np.zeros((fw.LIMBS, len(vals)), np.uint32)
+    for j, v in enumerate(vals):
+        for i in range(fw.LIMBS):
+            out[i, j] = (v >> (fw.RADIX * i)) & ((1 << fw.RADIX) - 1)
+    return jnp.asarray(out)
+
+
+def unpack(x):
+    x = np.asarray(x)
+    return [sum(int(x[i, j]) << (fw.RADIX * i) for i in range(fw.LIMBS))
+            for j in range(x.shape[1])]
+
+
+EDGES = [0, 1, 2, 19, 38, (1 << 15) - 1, 1 << 15, (1 << 255) - 20,
+         P - 1, P - 2, P - 19, (1 << 255) - 21]
+
+
+def test_mul_add_sub_random_and_edges():
+    rng = np.random.default_rng(7)
+    xs = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(128)]
+    ys = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(128)]
+    xs += [e % P for e in EDGES]
+    ys += [(P - 1 - e) % P for e in EDGES]
+    X, Y = pack(xs), pack(ys)
+    assert unpack(fw.canonical(fw.mul(X, Y))) == [
+        (a * b) % P for a, b in zip(xs, ys)]
+    assert unpack(fw.canonical(fw.add(X, Y))) == [
+        (a + b) % P for a, b in zip(xs, ys)]
+    assert unpack(fw.canonical(fw.sub_c(X, Y))) == [
+        (a - b) % P for a, b in zip(xs, ys)]
+    assert unpack(fw.canonical(fw.mul_small(X, 121665))) == [
+        (a * 121665) % P for a in xs]
+
+
+def test_lazy_chain_stays_in_bounds():
+    """50 rounds of mul(add(acc, y), acc) — the ladder's op mix — must not
+    overflow the lazy-carry domain."""
+    rng = np.random.default_rng(8)
+    xs = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(32)]
+    ys = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(32)]
+    acc, ref = pack(xs), xs[:]
+    Y = pack(ys)
+    for _ in range(50):
+        acc = fw.mul(fw.add(acc, Y), acc)
+        ref = [((a + b) * a) % P for a, b in zip(ref, ys)]
+    assert unpack(fw.canonical(acc)) == ref
+
+
+def test_canonical_subtracts_for_noncanonical_representatives():
+    """Byte vectors in [p, 2^255) — the range RFC 7748 decoding admits —
+    must canonicalize through the conditional-subtract branch."""
+    raws = [P, P + 1, P + 18, (1 << 255) - 1, P - 1, 0]
+    b = np.zeros((len(raws), 32), np.uint8)
+    for j, v in enumerate(raws):
+        b[j] = np.frombuffer(v.to_bytes(32, "little"), np.uint8)
+    w = fw.from_bytes_le(jnp.asarray(b))
+    assert unpack(fw.canonical(w)) == [v % P for v in raws]
+    back = np.asarray(fw.to_bytes_le(fw.canonical(w)))
+    assert [int.from_bytes(bytes(r), "little") for r in back] == [
+        v % P for v in raws]
+
+
+def test_bytes_roundtrip_accepts_noncanonical():
+    rng = np.random.default_rng(9)
+    b = rng.integers(0, 256, (64, 32), dtype=np.uint8)
+    b[:, 31] |= 0x80  # top bit must be ignored per RFC 7748 decoding
+    masked = b.copy()
+    masked[:, 31] &= 0x7F
+    vals = [int.from_bytes(bytes(r), "little") for r in masked]
+    w = fw.from_bytes_le(jnp.asarray(b))
+    assert unpack(w) == vals
+    back = np.asarray(fw.to_bytes_le(fw.canonical(w)))
+    assert [int.from_bytes(bytes(r), "little") for r in back] == [
+        v % P for v in vals]
+
+
+def test_std_conversions():
+    rng = np.random.default_rng(10)
+    xs = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(32)]
+    xs += [e % P for e in EDGES]
+    x8 = jnp.asarray(np.array(
+        [[(v >> (32 * i)) & 0xFFFFFFFF for v in xs] for i in range(8)],
+        np.uint32))
+    assert unpack(fw.from_std(x8)) == xs
+    s8 = np.asarray(fw.to_std(pack(xs)))
+    assert [sum(int(s8[i, j]) << (32 * i) for i in range(8))
+            for j in range(len(xs))] == xs
