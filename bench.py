@@ -371,6 +371,14 @@ def bench_service_plane(smoke: bool) -> dict:
         return agg.handle_aggregate_init(builder.task_id, jid, body,
                                          builder.aggregator_auth_token)
 
+    # Untimed warm round at the SAME job sizes: the hybrid HPKE device
+    # kernels compile per (lane bucket, ct len, aad len), and a timed
+    # section must never absorb an XLA compile.
+    warm_bodies = [(AggregationJobId((200 + j).to_bytes(16, "big")),
+                    build_body(200 + j, per_job)) for j in range(jobs)]
+    with ThreadPoolExecutor(jobs) as pool:
+        list(pool.map(run_one, warm_bodies))
+
     t0 = time.perf_counter()
     with ThreadPoolExecutor(jobs) as pool:
         list(pool.map(run_one, mj_bodies))

@@ -14,6 +14,8 @@ from __future__ import annotations
 import hashlib
 import threading
 import time as _time
+
+import numpy as np
 from dataclasses import dataclass, field
 
 from janus_tpu.aggregator import error as err
@@ -85,6 +87,10 @@ class AggregatorConfig:
     # every request needing a global key or peer paid a datastore tx.
     global_hpke_cache_ttl_s: float = 60.0
     peer_aggregator_cache_ttl_s: float = 60.0
+    # Minimum request size for the fused single-launch helper-init program
+    # (engine/fused_init.py).  Below this the coalescer's cross-job packing
+    # amortizes the device link round trip better than per-job launches.
+    fused_init_min_lanes: int = 4096
 
 
 class TaskAggregator:
@@ -119,6 +125,60 @@ class _ColumnarUnsupported(Exception):
     """Internal: the columnar init path hit a case it does not model (a
     lane left waiting by a multi-round VDAF); the caller redoes the request
     through the object path.  Never raised after datastore writes."""
+
+
+class _FusedAnomalous(Exception):
+    """Internal: the fused init launch flagged more anomalous lanes than
+    the per-lane host retry budget; the caller redoes the request through
+    the phase-structured columnar path (one uniform device batch), which
+    handles extension-bearing traffic natively.  Never raised after
+    datastore writes."""
+
+
+_UNKNOWN_CONFIG = object()  # _open_report_lanes sentinel
+
+
+def _validate_plaintext(taskprov: bool, pt: bytes) -> bytes | None:
+    """Full-codec PlaintextInputShare validation (extension rules shared
+    by columnar phase 1b and the fused retry path).  Returns the payload,
+    or None for INVALID_MESSAGE."""
+    from janus_tpu.messages import ExtensionType
+
+    try:
+        pis = PlaintextInputShare.decode(pt)
+        ext_types = [e.extension_type for e in pis.extensions]
+        if len(ext_types) != len(set(ext_types)):
+            raise ValueError("duplicate extensions")
+        has_tp = any(
+            e.extension_type == ExtensionType.TASKPROV
+            and e.extension_data == b""
+            for e in pis.extensions)
+        if taskprov and not has_tp:
+            raise ValueError("missing taskprov extension")
+        if not taskprov and any(
+                e.extension_type == ExtensionType.TASKPROV
+                for e in pis.extensions):
+            raise ValueError("unexpected taskprov extension")
+    except Exception:
+        return None
+    return pis.payload
+
+
+_resolve_pool = None
+_resolve_pool_lock = threading.Lock()
+
+
+def _resolve_executor():
+    """Shared 2-thread pool for overlapping device->host result fetches
+    with datastore writes (each fetch is a full link round trip)."""
+    global _resolve_pool
+    with _resolve_pool_lock:
+        if _resolve_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _resolve_pool = ThreadPoolExecutor(
+                2, thread_name_prefix="agg-resolve")
+        return _resolve_pool
 
 
 class Aggregator:
@@ -731,62 +791,73 @@ class Aggregator:
         agg_param, pbs, body, table = cols
         if pbs.query_type is not task.query_type.query_type:
             raise err.InvalidMessage("query type mismatch", task_id)
-        tl = table.tolist()
-        n = len(tl)
+        n = table.shape[0]
         if n == 0:
             raise err.EmptyAggregation(task_id)
-        ids = [body[r[0]:r[0] + 16] for r in tl]
-        if len(set(ids)) != n:
-            raise err.InvalidMessage(
-                "aggregate request contains duplicate report IDs", task_id)
-        times = [r[1] for r in tl]
         try:
             engine = ta.engine.bind(agg_param)
         except VdafError as e:
             raise err.InvalidMessage(f"bad aggregation parameter: {e}",
                                      task_id) from e
         deadline = self.clock.now().add(task.tolerable_clock_skew).seconds
+
+        # Fused single-launch path: HPKE open + parse + prepare as ONE
+        # device program, dispatched BEFORE any per-report host work so the
+        # kernel overlaps the checks below (engine/fused_init.py).  Falls
+        # through to the phase-structured path when the request doesn't
+        # fit the fused contract.  Threshold: below ~4k lanes the
+        # coalescer's cross-job packing amortizes the link round trip
+        # better than per-job fused launches (each fused launch pays the
+        # full fetch latency and its own kernel fixed cost).
+        launch = fused = None
+        if n >= self.cfg.fused_init_min_lanes and not task.taskprov:
+            cfg_ids = np.unique(table[:, 4])
+            if len(cfg_ids) == 1:
+                kp = task.hpke_keypair_for(HpkeConfigId(int(cfg_ids[0])))
+                if kp is None:
+                    kp = self._global_keypair(HpkeConfigId(int(cfg_ids[0])))
+                if kp is not None:
+                    from janus_tpu.engine.fused_init import fused_for
+
+                    fused = fused_for(engine)
+                    if fused is not None:
+                        launch = fused.run(
+                            kp, hpke.application_info(
+                                hpke.Label.INPUT_SHARE, Role.CLIENT,
+                                Role.HELPER),
+                            task.vdaf_verify_key, bytes(task_id), body,
+                            table)
+
+        tl = table.tolist()
+        ids = [body[r[0]:r[0] + 16] for r in tl]
+        if len(set(ids)) != n:
+            raise err.InvalidMessage(
+                "aggregate request contains duplicate report IDs", task_id)
+        times = [r[1] for r in tl]
         _mark("decode")
+
+        if launch is not None:
+            try:
+                return self._finish_init_fused(
+                    ta, task_id, job_id, request_hash, engine, launch,
+                    fused, tl, ids, times, body, agg_param, pbs, deadline,
+                    _mark, t_phase)
+            except _FusedAnomalous:
+                pass  # nothing persisted: redo via the phases below
 
         # Phase 1a: HPKE open, grouped by config id (cols: 4=config_id,
         # 5/6=enc off/len, 7/8=ct off/len, 2/3=pub off/len).
         lane_err: list[int | None] = [None] * n
-        tid_b = bytes(task_id)
-        kp_of: dict[int, object] = {}
-        groups: dict[int, list[int]] = {}
-        for i, r in enumerate(tl):
-            cfg = r[4]
-            if cfg not in kp_of:
-                kp = task.hpke_keypair_for(HpkeConfigId(cfg))
-                if kp is None:
-                    kp = self._global_keypair(HpkeConfigId(cfg))
-                kp_of[cfg] = kp
-            if kp_of[cfg] is None:
-                lane_err[i] = int(PrepareError.HPKE_UNKNOWN_CONFIG_ID)
-                continue
-            groups.setdefault(cfg, []).append(i)
-        input_share_info = hpke.application_info(
-            hpke.Label.INPUT_SHARE, Role.CLIENT, Role.HELPER)
-        plaintexts: list[bytes | None] = [None] * n
-        pk = struct.pack
-        for cfg, lanes in groups.items():
-            encs, payloads, aads = [], [], []
-            for i in lanes:
-                r = tl[i]
-                encs.append(body[r[5]:r[5] + r[6]])
-                payloads.append(body[r[7]:r[7] + r[8]])
-                aads.append(tid_b + ids[i] + pk(">Q", r[1])
-                            + pk(">I", r[3]) + body[r[2]:r[2] + r[3]])
-            try:
-                opened = hpke.open_ciphertexts_batch_raw(
-                    kp_of[cfg], input_share_info, encs, payloads, aads)
-            except (hpke.HpkeError, ValueError):
-                opened = [None] * len(lanes)
-            for i, pt in zip(lanes, opened):
-                if pt is None:
-                    lane_err[i] = int(PrepareError.HPKE_DECRYPT_ERROR)
-                else:
-                    plaintexts[i] = pt
+        plaintexts = self._open_report_lanes(
+            task, bytes(task_id), body, tl, ids, range(n))
+        UNKNOWN_CFG = int(PrepareError.HPKE_UNKNOWN_CONFIG_ID)
+        HPKE_ERR = int(PrepareError.HPKE_DECRYPT_ERROR)
+        for i, pt in enumerate(plaintexts):
+            if pt is _UNKNOWN_CONFIG:
+                lane_err[i] = UNKNOWN_CFG
+                plaintexts[i] = None
+            elif pt is None:
+                lane_err[i] = HPKE_ERR
         _mark("hpke")
 
         # Phase 1b: plaintext/message parse.  The no-extension layout is
@@ -815,27 +886,10 @@ class Aggregator:
                     continue
                 payload = pt[6:]
             else:
-                try:
-                    pis = PlaintextInputShare.decode(pt)
-                    ext_types = [e.extension_type for e in pis.extensions]
-                    if len(ext_types) != len(set(ext_types)):
-                        raise ValueError("duplicate extensions")
-                    from janus_tpu.messages import ExtensionType
-
-                    has_tp = any(
-                        e.extension_type == ExtensionType.TASKPROV
-                        and e.extension_data == b""
-                        for e in pis.extensions)
-                    if taskprov and not has_tp:
-                        raise ValueError("missing taskprov extension")
-                    if not taskprov and any(
-                            e.extension_type == ExtensionType.TASKPROV
-                            for e in pis.extensions):
-                        raise ValueError("unexpected taskprov extension")
-                except Exception:
+                payload = _validate_plaintext(taskprov, pt)
+                if payload is None:
                     lane_err[i] = INVALID
                     continue
-                payload = pis.payload
             if r[1] > deadline:
                 lane_err[i] = TOO_EARLY
                 continue
@@ -898,7 +952,196 @@ class Aggregator:
                 errors0[i] = VDAF_ERR
         _mark("assemble")
 
-        # Phase 4 (tx): replay/idempotency + batched writes + accumulation.
+        return self._init_commit_columnar(
+            ta, task_id, job_id, request_hash, engine, ids, times, kinds0,
+            errors0, resp_msgs0, fin_dev0, fin_raw0, agg_param, pbs, _mark,
+            t_phase)
+
+    def _finish_init_fused(self, ta, task_id, job_id, request_hash, engine,
+                           launch, fused, tl, ids, times, body, agg_param,
+                           pbs, deadline, _mark, t_phase) -> bytes:
+        """Consume a FusedLaunch (engine/fused_init.py): map per-lane flags
+        to protocol outcomes, re-run flagged anomalies through the host
+        codec (full extension semantics), then commit via the shared
+        phase-4 path.  Error precedence matches the columnar path exactly:
+        HPKE > plaintext-parse > TOO_EARLY > message-parse > VDAF."""
+        task = ta.task
+        n = len(ids)
+        res = launch.fetch()
+        _mark("device")
+
+        HPKE_ERR = int(PrepareError.HPKE_DECRYPT_ERROR)
+        TOO_EARLY = int(PrepareError.REPORT_TOO_EARLY)
+        VDAF_ERR = int(PrepareError.VDAF_PREP_ERROR)
+        kinds0 = bytearray(n)
+        errors0 = [0] * n
+        resp_msgs0: list[bytes] = [b""] * n
+        fin_dev0: list = [None] * n
+        fin_raw0: list = [None] * n
+
+        ok_hpke = res["ok_hpke"]
+        pt_ok = res["pt_ok"]
+        msg_ok = res["msg_ok"]
+        range_ok = res["range_ok"]
+        proof_ok = res["proof_ok"]
+        jr_ok = res["jr_ok"]
+        fallback = res["fallback"]
+        seeds = res["msg_seeds"]
+        seed_blob = seeds.tobytes()
+        ss = seeds.shape[1]
+
+        # Lanes the kernel could not settle: non-fast-layout plaintexts
+        # (legal extension-bearing reports decode on the host), odd
+        # ping-pong messages, and XOF rejection-sampling fallbacks.  A
+        # large anomaly fraction means the fused contract mispredicted the
+        # traffic — redo the WHOLE request on the phase-structured
+        # columnar path (one uniform device batch) rather than per-lane
+        # host math.
+        ok_hpke_l = ok_hpke.tolist()
+        pt_ok_l = pt_ok.tolist()
+        msg_ok_l = msg_ok.tolist()
+        settled_l = (range_ok & proof_ok & jr_ok).tolist()
+        fallback_l = fallback.tolist()
+        retry = [i for i in range(n)
+                 if ok_hpke_l[i] and (not pt_ok_l[i] or not msg_ok_l[i]
+                                      or fallback_l[i])]
+        if len(retry) > max(64, n // 20):
+            raise _FusedAnomalous
+
+        pk_i = int.to_bytes
+        ss_be = pk_i(ss, 4, "big")
+        for i in range(n):
+            if not ok_hpke_l[i]:
+                kinds0[i] = 2
+                errors0[i] = HPKE_ERR
+            elif not pt_ok_l[i] or not msg_ok_l[i] or fallback_l[i]:
+                continue  # settled by _fused_retry_lanes below
+            elif times[i] > deadline:
+                kinds0[i] = 2
+                errors0[i] = TOO_EARLY
+            elif not settled_l[i]:
+                kinds0[i] = 2
+                errors0[i] = VDAF_ERR
+            else:
+                kinds0[i] = 0
+                resp_msgs0[i] = (b"\x02" + ss_be
+                                 + seed_blob[i * ss:(i + 1) * ss])
+                fin_dev0[i] = (launch.device_shares, i)
+
+        if retry:
+            self._fused_retry_lanes(
+                task, fused.engine, body, tl, ids, times, deadline, retry,
+                kinds0, errors0, resp_msgs0, fin_raw0)
+        _mark("assemble")
+
+        return self._init_commit_columnar(
+            ta, task_id, job_id, request_hash, engine, ids, times, kinds0,
+            errors0, resp_msgs0, fin_dev0, fin_raw0, agg_param, pbs, _mark,
+            t_phase)
+
+    def _open_report_lanes(self, task, tid_b: bytes, body: bytes, tl, ids,
+                           lanes) -> list:
+        """Grouped-by-config HPKE open of `lanes` (columnar phase 1a and
+        the fused retry path share this).  Returns a list aligned with
+        `lanes`: plaintext bytes, None (decrypt failure), or the
+        _UNKNOWN_CONFIG sentinel."""
+        import struct
+
+        pk = struct.pack
+        info = hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT,
+                                     Role.HELPER)
+        lanes = list(lanes)
+        out: list = [None] * len(lanes)
+        kp_of: dict[int, object] = {}
+        groups: dict[int, list[int]] = {}
+        for j, i in enumerate(lanes):
+            cfg = tl[i][4]
+            if cfg not in kp_of:
+                kp = task.hpke_keypair_for(HpkeConfigId(cfg))
+                if kp is None:
+                    kp = self._global_keypair(HpkeConfigId(cfg))
+                kp_of[cfg] = kp
+            if kp_of[cfg] is None:
+                out[j] = _UNKNOWN_CONFIG
+                continue
+            groups.setdefault(cfg, []).append(j)
+        for cfg, idxs in groups.items():
+            encs, payloads, aads = [], [], []
+            for j in idxs:
+                r = tl[lanes[j]]
+                encs.append(body[r[5]:r[5] + r[6]])
+                payloads.append(body[r[7]:r[7] + r[8]])
+                aads.append(tid_b + ids[lanes[j]] + pk(">Q", r[1])
+                            + pk(">I", r[3]) + body[r[2]:r[2] + r[3]])
+            try:
+                opened = hpke.open_ciphertexts_batch_raw(
+                    kp_of[cfg], info, encs, payloads, aads)
+            except (hpke.HpkeError, ValueError):
+                opened = [None] * len(idxs)
+            for j, pt in zip(idxs, opened):
+                out[j] = pt
+        return out
+
+    def _fused_retry_lanes(self, task, bengine, body, tl, ids, times,
+                           deadline, retry, kinds0, errors0, resp_msgs0,
+                           fin_raw0) -> None:
+        """Host-codec re-run of fused-flagged lanes (rare path): batched
+        HPKE open, then the full PlaintextInputShare/ping-pong semantics
+        per lane — the same shared helpers as columnar phases 1a/1b, plus
+        host prepare."""
+        INVALID = int(PrepareError.INVALID_MESSAGE)
+        TOO_EARLY = int(PrepareError.REPORT_TOO_EARLY)
+        VDAF_ERR = int(PrepareError.VDAF_PREP_ERROR)
+        HPKE_ERR = int(PrepareError.HPKE_DECRYPT_ERROR)
+        opened = self._open_report_lanes(
+            task, bytes(task.task_id), body, tl, ids, retry)
+        mk_msg = ping_pong.PingPongMessage
+        for j, i in enumerate(retry):
+            pt = opened[j]
+            if pt is None or pt is _UNKNOWN_CONFIG:
+                kinds0[i] = 2
+                errors0[i] = HPKE_ERR
+                continue
+            r = tl[i]
+            payload = _validate_plaintext(task.taskprov, pt)
+            if payload is None:
+                kinds0[i] = 2
+                errors0[i] = INVALID
+                continue
+            if r[1] > deadline:
+                kinds0[i] = 2
+                errors0[i] = TOO_EARLY
+                continue
+            mb = body[r[9]:r[9] + r[10]]
+            try:
+                inbound = mk_msg.decode(mb)
+            except VdafError:
+                kinds0[i] = 2
+                errors0[i] = INVALID
+                continue
+            rep = bengine._host_helper(
+                task.vdaf_verify_key, ids[i], body[r[2]:r[2] + r[3]],
+                payload, inbound)
+            if rep.status == "finished":
+                kinds0[i] = 0
+                resp_msgs0[i] = rep.outbound.encode()
+                fin_raw0[i] = rep.out_share_raw
+            else:
+                kinds0[i] = 2
+                errors0[i] = VDAF_ERR
+
+    def _init_commit_columnar(self, ta, task_id, job_id, request_hash,
+                              engine, ids, times, kinds0, errors0,
+                              resp_msgs0, fin_dev0, fin_raw0, agg_param,
+                              pbs, _mark, t_phase) -> bytes:
+        """Phase 4 of the columnar/fused init paths: replay/idempotency +
+        batched writes + accumulation, inside one datastore transaction."""
+        import struct
+
+        pk = struct.pack
+        task = ta.task
+        n = len(ids)
+        tid_b = bytes(task_id)
         logic = ta.logic
         precision = task.time_precision.seconds
         fixed_ident = None
@@ -937,8 +1180,13 @@ class Aggregator:
             mask = _np.zeros(first.shape[-1], dtype=bool)
             for i in fin0:
                 mask[fin_dev0[i][1]] = True
-            pre_agg[key] = (frozenset(fin0),
-                            engine.aggregate_masked_launch(first, mask))
+            handle = engine.aggregate_masked_launch(first, mask)
+            # Materialize on a background thread: the device->host fetch
+            # costs a full link round trip, which this hides behind the
+            # transaction's own scrub/replay/insert statements.
+            fut = _resolve_executor().submit(engine.aggregate_resolve,
+                                             handle)
+            pre_agg[key] = (frozenset(fin0), fut)
 
         def txn(tx):
             existing = tx.get_aggregation_job(task_id, job_id)
@@ -1061,7 +1309,7 @@ class Aggregator:
                         # the finished set survived replay/collected checks:
                         # the device reduce launched pre-tx is (probably
                         # already) done — just materialize it
-                        delta_share = engine.aggregate_resolve(pre[1])
+                        delta_share = pre[1].result()
                     else:
                         delta_share = self._aggregate_columnar(
                             engine, [fin_dev[i] for i in fin],

@@ -150,11 +150,13 @@ def sq(x):
 
 
 def mul_small(x, c: int):
-    """Multiply by a constant c < 2^26 (covers the ladder's a24=121665).
+    """Multiply by a constant c < 2^24 (covers the ladder's a24=121665).
     Input limbs < 2^16.  c splits at the radix: x*c = x*c0 + (x*c1)<<15,
-    the shifted part re-entering limb 0 *19 at the top — that fold term
-    u[16]*19 < c1 * 2^16 * 19 must stay below 2^32, bounding c1 < 2^11."""
-    assert 0 <= c < (1 << 26)
+    the shifted part re-entering limb 0 *19 at the top.  Worst-case limb:
+    x0*c0 + 19*x16*c1 < 2^16*(c0 + 19*c1) — keeping that below 2^32 for
+    any split needs c0 + 19*c1 < 2^16, which c < 2^24 guarantees
+    (c0 < 2^15, c1 < 2^9 -> c0 + 19*c1 < 2^15 + 19*2^9 < 2^16)."""
+    assert 0 <= c < (1 << 24)
     c0, c1 = c & ((1 << RADIX) - 1), c >> RADIX
     t = x * _U32(c0) if c0 else jnp.zeros_like(x)  # < 2^31
     if c1:

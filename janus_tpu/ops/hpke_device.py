@@ -60,22 +60,12 @@ def _key_schedule_context(info: bytes) -> bytes:
     return b"\x00" + psk_id_hash + info_hash
 
 
-def _open_kernel(bundle, c: int, a: int):
-    """The jitted body over ONE bundled u8 tensor (the chip sits behind a
-    network tunnel here, so per-argument transfers cost a round trip each —
-    the whole request ships as one upload and one download):
+def open_core(sk, pk_r, ksc, encs, cts, aads):
+    """Kernel-side RFC 9180 open chain, shared by the standalone open
+    kernel and the fused helper-init program (engine/fused_init.py).
 
-    row 0:    sk(32) | pk_r(32) | key-schedule context(65) | pad
-    rows 1..: enc(32) | ct(c)   | aad(a)                   | pad
-
-    Returns u8 [N, c-16+1]: plaintext bytes with the per-lane ok flag as
-    the trailing byte."""
-    sk = bundle[0, :32]
-    pk_r = bundle[0, 32:64]
-    ksc = bundle[0, 64:129]
-    encs = bundle[1:, :32]
-    cts = bundle[1:, 32:32 + c]
-    aads = bundle[1:, 32 + c:32 + c + a]
+    sk/pk_r [32] u8, ksc [65] u8 (host key-schedule context), encs [N,32],
+    cts [N,C], aads [N,A].  Returns (pt [N, C-16] u8, ok [N] bool)."""
     n = encs.shape[0]
     dh, nonzero = x25519.scalar_mult(sk, encs)
 
@@ -101,8 +91,32 @@ def _open_kernel(bundle, c: int, a: int):
     base_nonce = lexp(secret, b"base_nonce", _SUITE, ksc_b, 12)
 
     pt, ok = aes128_gcm_open(key, base_nonce, aads, cts)
-    ok = (ok & nonzero).astype(jnp.uint8)
-    return jnp.concatenate([pt, ok[:, None]], axis=-1)
+    return pt, ok & nonzero
+
+
+def key_schedule_context(info: bytes) -> bytes:
+    """Public alias for the host-side key-schedule context computation."""
+    return _key_schedule_context(info)
+
+
+def _open_kernel(bundle, c: int, a: int):
+    """The jitted body over ONE bundled u8 tensor (the chip sits behind a
+    network tunnel here, so per-argument transfers cost a round trip each —
+    the whole request ships as one upload and one download):
+
+    row 0:    sk(32) | pk_r(32) | key-schedule context(65) | pad
+    rows 1..: enc(32) | ct(c)   | aad(a)                   | pad
+
+    Returns u8 [N, c-16+1]: plaintext bytes with the per-lane ok flag as
+    the trailing byte."""
+    sk = bundle[0, :32]
+    pk_r = bundle[0, 32:64]
+    ksc = bundle[0, 64:129]
+    encs = bundle[1:, :32]
+    cts = bundle[1:, 32:32 + c]
+    aads = bundle[1:, 32 + c:32 + c + a]
+    pt, ok = open_core(sk, pk_r, ksc, encs, cts, aads)
+    return jnp.concatenate([pt, ok.astype(jnp.uint8)[:, None]], axis=-1)
 
 
 _jit_cache: dict[tuple[int, int, int], object] = {}
