@@ -525,6 +525,24 @@ def main():
                 "failed_lanes_warmup": n_bad,
                 "host_fallbacks": engine.fallback_count,
             }
+            if name == "Prio3SumVec1000":
+                # chip-capability vs link-weather attribution for the
+                # north-star config: the kernel-sustained rate with inputs
+                # already in HBM, and the ceiling the measured uplink
+                # imposes on ANY end-to-end run at this wire size
+                inner_e = getattr(engine, "inner", engine)
+                try:
+                    dev_rps = inner_e.device_resident_rate(
+                        verify_key, nonces[:batch], pubs[:batch],
+                        shares[:batch], inits[:batch])
+                    detail[name]["device_resident_reports_per_sec"] = round(
+                        dev_rps, 1)
+                except Exception as e:
+                    detail[name]["device_resident_reports_per_sec"] = (
+                        f"error: {type(e).__name__}")
+                if link and "up_MBps" in link:
+                    detail[name]["link_bound_ceiling_reports_per_sec"] = (
+                        round(link["up_MBps"] * 1e6 / wire_bytes, 1))
         except Exception as e:  # keep the harness unattended-safe
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
 

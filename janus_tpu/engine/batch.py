@@ -207,6 +207,61 @@ class BatchPrio3:
 
         return round_up(bucket_size(n), self._n_devices)
 
+    # Chunked double-buffering (helper path): a big batch ships as 3-4
+    # exact-bucket chunks dispatched back-to-back, so the upload of chunk
+    # k+1 overlaps the kernel of chunk k on the device queue — transfers
+    # DO overlap compute on this runtime (measured: 8MB H2D + 286ms kernel
+    # = 1046ms combined vs 1418ms serial).  Chunks are contiguous and only
+    # the last is padded, so report i stays at concat lane i.
+    #
+    # OFF BY DEFAULT, by measurement: at 24576 SumVec-1000 lanes the
+    # 3-chunk pipeline ran ~40% SLOWER than one launch on the tunneled
+    # chip — each chunk kernel pays the full per-launch fixed cost
+    # (~60-100ms of scan dispatch overhead), which outweighs the overlap
+    # it buys, and concurrent jobs (the service's normal shape) already
+    # overlap their transfers with each other's kernels for free.  The
+    # mechanism stays for giant single jobs and PCIe-attached chips where
+    # per-launch overhead is microseconds: set JANUS_TPU_CHUNKED_DISPATCH=1
+    # (or flip the instance attribute) to enable.
+    _CHUNK_MIN = 8192
+    chunked_dispatch = bool(int(
+        __import__("os").environ.get("JANUS_TPU_CHUNKED_DISPATCH", "0")))
+
+    def _chunk_plan(self, n: int) -> list[int] | None:
+        if (not self.chunked_dispatch or self.mesh is not None
+                or n < 2 * self._CHUNK_MIN):
+            return None
+        target = -(-n // 3)
+        c = 8
+        while True:  # engine-grid floor: largest bucket <= target
+            # grid walk: power of two -> *3/2 midpoint -> next power of two
+            nxt = c * 3 // 2 if (c & (c - 1)) == 0 else c * 4 // 3
+            if nxt > target:
+                break
+            c = nxt
+        full, rem = divmod(n, c)
+        sizes = [c] * full
+        if rem:
+            sizes.append(bucket_size(rem))
+        return sizes if len(sizes) > 1 else None
+
+    def _concat_fn(self, sizes: tuple[int, ...]):
+        """Jitted on-device concat of per-chunk outputs: the host then
+        pays ONE result fetch instead of one per chunk (each fetch costs
+        a full link round trip)."""
+        key = ("concat",) + sizes
+        fn = self._helper_fns.get(key)
+        if fn is None:
+            k = len(sizes)
+
+            def concat(*arrs):
+                return (jnp.concatenate(arrs[:k], axis=0),
+                        jnp.concatenate(arrs[k:], axis=-1))
+
+            fn = jax.jit(concat)
+            self._helper_fns[key] = fn
+        return fn
+
     def _jit(self, kernel, n_sharded_args: int, out_specs):
         """jit, sharding batch arguments/outputs over the report mesh when
         one is configured.
@@ -462,42 +517,17 @@ class BatchPrio3:
 
     # -- public batched API ----------------------------------------------
 
-    def helper_init_batch(
-        self,
-        verify_key: bytes | list[bytes],
-        nonces: list[bytes],
-        public_shares: list[bytes],
-        input_shares: list[bytes],
-        inbound_messages: list[ping_pong.PingPongMessage],
-    ) -> list[PreparedReport]:
-        """Batched ping_pong.helper_initialized + transition.evaluate().
-
-        `verify_key` is one key for the whole batch, or one PER REPORT (a
-        coalesced launch mixing jobs from different tasks — SURVEY §2.7 P2).
-        Returns one PreparedReport per input, in order: status "finished"
-        with the outbound finish message and raw output share, or "failed"
-        with the reason (bad proof / joint rand mismatch / decode error).
-        """
+    def _pack_helper_inputs(self, M, verify_key, nonces, public_shares,
+                            input_shares, inbound_messages):
+        """Host-side packing for the helper kernel: bundled byte tensor
+        (vk | seeds | blinds | nonces | pub0 | leader_jr_parts — one
+        transfer instead of six) + the leader verifier limbs + per-lane
+        decode errors.  Vectorized: a length-scan in Python (cheap), then
+        one bulk frombuffer + range check over all well-formed reports."""
         N = len(nonces)
-        assert N == len(public_shares) == len(input_shares) == len(inbound_messages)
         per_report_vk = not isinstance(verify_key, (bytes, bytearray))
-
-        def vk_for(i: int) -> bytes:
-            return verify_key[i] if per_report_vk else verify_key
-
-        if not self.device_ok:
-            return [
-                self._host_helper(vk_for(i), nonces[i], public_shares[i],
-                                  input_shares[i], inbound_messages[i])
-                for i in range(N)
-            ]
-
-        t_begin = time.monotonic()
-        M = self._bucket(N)
         ss = self.vdaf.SEED_SIZE
         ks = self.vdaf.VERIFY_KEY_SIZE
-        # single bundled byte tensor: vk | seeds | blinds | nonces | pub0 |
-        # leader_jr_parts (see _helper_fn) — one transfer instead of six
         packed = np.zeros((M, ks + 4 * ss + 16), dtype=np.uint8)
         vk = packed[:, :ks]
         seeds = packed[:, ks:ks + ss]
@@ -505,11 +535,9 @@ class BatchPrio3:
         nonce_rows = packed[:, ks + 2 * ss:ks + 2 * ss + 16]
         pub0 = packed[:, ks + 2 * ss + 16:ks + 3 * ss + 16]
         ljr = packed[:, ks + 3 * ss + 16:ks + 4 * ss + 16]
-        lverif = np.zeros((M, self.P * self.flp.VERIFIER_LEN, self.L), dtype=np.uint32)
+        lverif = np.zeros((M, self.P * self.flp.VERIFIER_LEN, self.L),
+                          dtype=np.uint32)
         decode_err: dict[int, str] = {}
-
-        # Vectorized decode: length-scan in Python (cheap), then one bulk
-        # frombuffer + range check over all well-formed reports.
         ishare_len = ss + (ss if self.has_jr else 0)
         pub_len = self.vdaf.shares * ss if self.has_jr else 0
         ps_jr = ss if self.has_jr else 0
@@ -551,8 +579,77 @@ class BatchPrio3:
             vk[:N] = _bytes_rows(list(verify_key), ks)
         else:
             vk[:N] = np.frombuffer(verify_key, dtype=np.uint8)
-        fn = self._helper_fn(M)
         nonce_rows[:N] = nonces_arr(nonces)
+        return packed, lverif, decode_err
+
+    def device_resident_rate(self, verify_key, nonces, public_shares,
+                             input_shares, inbound_messages,
+                             iters: int = 3) -> float:
+        """Kernel-sustained helper-init rate with inputs ALREADY in HBM —
+        the bench publishes this beside the end-to-end number so the
+        artifact separates chip capability from link weather (the tunneled
+        deployment's uplink swings 5 MB/s-1 GB/s run to run)."""
+        import jax as _jax
+
+        if not self.device_ok:
+            raise RuntimeError(
+                "device_resident_rate is a chip-capability metric; this "
+                "engine is on the host path")
+        N = len(nonces)
+        M = self._bucket(N)
+        packed, lverif, _err = self._pack_helper_inputs(
+            M, verify_key, nonces, public_shares, input_shares,
+            inbound_messages)
+        fn = self._helper_fn(M)
+        packed_d = _jax.device_put(packed)
+        lverif_d = _jax.device_put(lverif)
+        out = fn(packed_d, lverif_d)
+        out[0].block_until_ready()  # compile + warm
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.monotonic()
+            out = fn(packed_d, lverif_d)
+            out[0].block_until_ready()
+            best = min(best, time.monotonic() - t0)
+        return N / best
+
+    def helper_init_batch(
+        self,
+        verify_key: bytes | list[bytes],
+        nonces: list[bytes],
+        public_shares: list[bytes],
+        input_shares: list[bytes],
+        inbound_messages: list[ping_pong.PingPongMessage],
+    ) -> list[PreparedReport]:
+        """Batched ping_pong.helper_initialized + transition.evaluate().
+
+        `verify_key` is one key for the whole batch, or one PER REPORT (a
+        coalesced launch mixing jobs from different tasks — SURVEY §2.7 P2).
+        Returns one PreparedReport per input, in order: status "finished"
+        with the outbound finish message and raw output share, or "failed"
+        with the reason (bad proof / joint rand mismatch / decode error).
+        """
+        N = len(nonces)
+        assert N == len(public_shares) == len(input_shares) == len(inbound_messages)
+        per_report_vk = not isinstance(verify_key, (bytes, bytearray))
+
+        def vk_for(i: int) -> bytes:
+            return verify_key[i] if per_report_vk else verify_key
+
+        if not self.device_ok:
+            return [
+                self._host_helper(vk_for(i), nonces[i], public_shares[i],
+                                  input_shares[i], inbound_messages[i])
+                for i in range(N)
+            ]
+
+        t_begin = time.monotonic()
+        chunk_sizes = self._chunk_plan(N)
+        M = sum(chunk_sizes) if chunk_sizes else self._bucket(N)
+        ss = self.vdaf.SEED_SIZE
+        packed, lverif, decode_err = self._pack_helper_inputs(
+            M, verify_key, nonces, public_shares, input_shares,
+            inbound_messages)
         from janus_tpu.metrics import device_batch_reports, device_batch_seconds
 
         t0 = time.monotonic()
@@ -562,7 +659,20 @@ class BatchPrio3:
         # out_share_d with a lane mask and transfers one [OUTPUT_LEN, L] sum
         # per batch (HBM-bandwidth discipline; the 1-round helper never
         # sends its verifier on the wire, only the finish seed).
-        packed_out_d, out_share_d = fn(packed, lverif)
+        if chunk_sizes:
+            # back-to-back chunk dispatch: chunk k+1's upload overlaps
+            # chunk k's kernel; a device-side concat keeps the host at ONE
+            # result fetch (each fetch costs a full link round trip)
+            parts, off = [], 0
+            for c in chunk_sizes:
+                cfn = self._helper_fn(c)
+                parts.append(cfn(packed[off:off + c], lverif[off:off + c]))
+                off += c
+            packed_out_d, out_share_d = self._concat_fn(tuple(chunk_sizes))(
+                *[p[0] for p in parts], *[p[1] for p in parts])
+        else:
+            fn = self._helper_fn(M)
+            packed_out_d, out_share_d = fn(packed, lverif)
         packed_out = np.asarray(packed_out_d)
         msg_seed = packed_out[:, :ss]
         proof_ok = packed_out[:, ss].astype(bool)

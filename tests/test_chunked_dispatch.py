@@ -1,0 +1,81 @@
+"""Chunked double-buffered helper dispatch (BatchPrio3._chunk_plan) vs the
+single-launch path: identical statuses, messages, and aggregates.
+
+The chunk plan exists for transfer/compute overlap on the tunneled chip
+(reference workload: aggregator/src/aggregator.rs:1763-2013's helper
+loop); this pins that the decomposition is outcome-invariant."""
+
+import numpy as np
+import pytest
+
+from janus_tpu.engine.batch import BatchPrio3, bucket_size
+from janus_tpu.models import VdafInstance
+from janus_tpu.models.vdaf_instance import vdaf_for_instance
+from janus_tpu.vdaf import ping_pong as pp
+
+
+def _mk_reports(vdaf, verify_key, n):
+    nonces, pubs, shares, inits = [], [], [], []
+    base = 8
+    for i in range(base):
+        nonce = i.to_bytes(16, "big")
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard(i % 2, nonce, rand)
+        _st, msg = pp.leader_initialized(vdaf, verify_key, nonce, pub,
+                                         ishares[0])
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares.append(vdaf.encode_input_share(1, ishares[1]))
+        inits.append(msg)
+    reps = n // base + 1
+    return ([x for x in nonces * reps][:n], [x for x in pubs * reps][:n],
+            [x for x in shares * reps][:n], [x for x in inits * reps][:n])
+
+
+def test_chunk_plan_grid():
+    e = BatchPrio3(vdaf_for_instance(VdafInstance.prio3_count()))
+    assert e._chunk_plan(24576) is None          # off by default
+    e.chunked_dispatch = True
+    assert e._chunk_plan(100) is None            # below the floor
+    plan = e._chunk_plan(24576)
+    assert plan == [8192, 8192, 8192]            # exact buckets, no pad
+    plan = e._chunk_plan(20000)
+    assert sum(plan) >= 20000
+    assert all(s == plan[0] for s in plan[:-1])
+    assert plan[-1] == bucket_size(20000 - plan[0] * (len(plan) - 1))
+
+
+def test_chunked_matches_single_launch():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    n = 300
+    nonces, pubs, shares, inits = _mk_reports(vdaf, vk, n)
+    # tamper a few lanes so failure statuses cross chunk boundaries
+    shares = list(shares)
+    shares[5] = shares[5][:-1] + bytes([shares[5][-1] ^ 1])
+    shares[200] = b""
+
+    chunked = BatchPrio3(vdaf)
+    chunked.chunked_dispatch = True
+    chunked._CHUNK_MIN = 64  # instance override: exercise chunks at n=300
+    single = BatchPrio3(vdaf)
+    assert chunked._chunk_plan(n) is not None
+    assert single._chunk_plan(n) is None
+
+    rc = chunked.helper_init_batch(vk, nonces, pubs, shares, inits)
+    rs = single.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert [r.status for r in rc] == [r.status for r in rs]
+    assert [r.outbound.encode() if r.outbound else None for r in rc] == \
+           [r.outbound.encode() if r.outbound else None for r in rs]
+
+    fin = [i for i, r in enumerate(rc) if r.status == "finished"]
+    assert fin
+    mask_c = np.zeros(rc[fin[0]].device_shares.shape[-1], dtype=bool)
+    mask_s = np.zeros(rs[fin[0]].device_shares.shape[-1], dtype=bool)
+    for i in fin:
+        assert rc[i].lane == i  # chunk concat preserves report order
+        mask_c[rc[i].lane] = True
+        mask_s[rs[i].lane] = True
+    agg_c = chunked.aggregate_masked(rc[fin[0]].device_shares, mask_c)
+    agg_s = single.aggregate_masked(rs[fin[0]].device_shares, mask_s)
+    assert agg_c == agg_s

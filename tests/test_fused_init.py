@@ -13,6 +13,7 @@ from janus_tpu.core import hpke as _hpke
 from janus_tpu.core.time import MockClock
 from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
 from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+from janus_tpu.engine import fused_init as fi
 from janus_tpu.messages import (
     TIME_INTERVAL,
     AggregationJobId,
@@ -40,38 +41,44 @@ N = 600
 T0 = 1_600_000_000
 
 
-def _build_body(builder, clock, n=N, tamper=True):
-    """n reports with a sprinkle of every anomaly the fused kernel must
-    flag: HPKE tamper, extension-bearing (legal, non-fast-layout)
-    plaintexts, malformed ping-pong messages, too-early timestamps."""
+def _build_body(builder, n=N, with_extensions=False):
+    """n reports with a sprinkle of every UNIFORM-LENGTH anomaly the fused
+    kernel must flag: HPKE tamper, malformed ping-pong messages, too-early
+    timestamps.  (`with_extensions` adds extension-bearing plaintexts,
+    which change the wire lengths and so force the whole request off the
+    fused contract — covered by its own test.)"""
     vdaf = vdaf_for_instance(builder.vdaf)
     info = _hpke.application_info(_hpke.Label.INPUT_SHARE, Role.CLIENT,
                                   Role.HELPER)
+    meas_one = (1 if not getattr(vdaf.flp.valid, "length", None)
+                else [1] * vdaf.flp.valid.length)
+    meas_zero = (0 if not getattr(vdaf.flp.valid, "length", None)
+                 else [0] * vdaf.flp.valid.length)
     inits = []
     for i in range(n):
         rid = i.to_bytes(16, "big")
         rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
-        pub, shares = vdaf.shard(1 if i % 3 else 0, rid, rand)
+        pub, shares = vdaf.shard(meas_one if i % 3 else meas_zero, rid, rand)
         pub_enc = vdaf.encode_public_share(pub)
         t = Time(T0) if i % 7 else Time(T0 + 9_999)  # some too-early
         meta = ReportMetadata(ReportId(rid), t)
         exts = ()
-        if tamper and i % 11 == 0:
+        if with_extensions and i % 11 == 0:
             exts = (Extension(ExtensionType(23), b"x"),)
         plaintext = PlaintextInputShare(
             exts, vdaf.encode_input_share(1, shares[1])).encode()
         aad = InputShareAad(builder.task_id, meta, pub_enc).encode()
         ct = _hpke.seal(builder.helper_hpke_keypair.config, info, plaintext,
                         aad)
-        if tamper and i % 13 == 0:
+        if i % 13 == 0:
             ct = HpkeCiphertext(
                 ct.config_id, ct.encapsulated_key,
                 ct.payload[:-1] + bytes([ct.payload[-1] ^ 1]))
         _st, msg = pp.leader_initialized(
             vdaf, builder.verify_key, rid, pub, shares[0])
         mb = msg.encode()
-        if tamper and i % 17 == 0:
-            mb = b"\x07" + mb[1:]
+        if i % 17 == 0:
+            mb = b"\x07" + mb[1:]  # same length, bad type: host-retry lane
         inits.append(PrepareInit(ReportShare(meta, pub_enc, ct), mb))
     return AggregationJobInitializeReq(
         aggregation_parameter=b"",
@@ -79,19 +86,45 @@ def _build_body(builder, clock, n=N, tamper=True):
         prepare_inits=tuple(inits)).encode()
 
 
-def _run(instance, fused: bool):
+class _FusedSpy:
+    """Counts FusedHelperInit.run calls and non-None launches."""
+
+    def __init__(self):
+        self.calls = 0
+        self.launches = 0
+        self._orig = fi.FusedHelperInit.run
+
+    def __enter__(self):
+        spy = self
+
+        def run(inner_self, *a, **k):
+            spy.calls += 1
+            res = spy._orig(inner_self, *a, **k)
+            if res is not None:
+                spy.launches += 1
+            return res
+
+        fi.FusedHelperInit.run = run
+        return self
+
+    def __exit__(self, *exc):
+        fi.FusedHelperInit.run = self._orig
+
+
+def _run(instance, fused: bool, with_extensions=False):
     builder = TaskBuilder(QueryTypeCfg.time_interval(), instance)
     clock = MockClock(Time(T0))
-    body = _build_body(builder, clock)
+    body = _build_body(builder, with_extensions=with_extensions)
     ds = Datastore(SqliteBackend(), Crypter.generate(), clock)
     ds.put_schema()
     ds.run_tx("put", lambda tx: tx.put_aggregator_task(builder.helper_view()))
     agg = Aggregator(ds, clock, AggregatorConfig(
         batch_aggregation_shard_count=4,
         fused_init_min_lanes=(512 if fused else 10 ** 9)))
-    resp = agg.handle_aggregate_init(
-        builder.task_id, AggregationJobId(bytes(16)), body,
-        builder.aggregator_auth_token)
+    with _FusedSpy() as spy:
+        resp = agg.handle_aggregate_init(
+            builder.task_id, AggregationJobId(bytes(16)), body,
+            builder.aggregator_auth_token)
     ident = Interval(Time(T0 - T0 % 3600), Duration(3600))
 
     def q(tx):
@@ -110,35 +143,39 @@ def _run(instance, fused: bool):
                 (a + b) % F.MODULUS for a, b in zip(tot, v)]
         return count, ck, tuple(tot) if tot else None
 
-    return resp, ds.run_tx("q", q)
+    return resp, ds.run_tx("q", q), spy
 
 
-@pytest.mark.parametrize("instance", [VdafInstance.prio3_count()],
-                         ids=["count"])
+@pytest.mark.parametrize(
+    "instance",
+    [VdafInstance.prio3_count(), VdafInstance.prio3_sum(8)],
+    ids=["count", "sum8-jointrand"])
 def test_fused_matches_columnar(instance):
-    resp_f, agg_f = _run(instance, fused=True)
-    resp_o, agg_o = _run(instance, fused=False)
+    resp_f, agg_f, spy_f = _run(instance, fused=True)
+    # the fused kernel must actually have LAUNCHED (uniform wire lengths),
+    # or this parity test is comparing the columnar path to itself
+    assert spy_f.calls == 1 and spy_f.launches == 1
+    resp_o, agg_o, spy_o = _run(instance, fused=False)
+    assert spy_o.calls == 0
     assert resp_f == resp_o
     assert agg_f == agg_o
-    # sanity: the body really contained accepted lanes
     assert agg_f[0] > 0
+
+
+def test_extension_lanes_fall_off_the_fused_contract():
+    """Extension-bearing plaintexts lengthen their lanes' wire records, so
+    run() must refuse (non-uniform lengths) and the handler must produce
+    the columnar path's exact result."""
+    inst = VdafInstance.prio3_count()
+    resp_f, agg_f, spy_f = _run(inst, fused=True, with_extensions=True)
+    assert spy_f.calls == 1 and spy_f.launches == 0
+    resp_o, agg_o, _ = _run(inst, fused=False, with_extensions=True)
+    assert resp_f == resp_o
+    assert agg_f == agg_o
 
 
 def test_fused_gate_respects_threshold():
     """Below the configured lane floor the handler must not build fused
     programs (concurrent small jobs coalesce instead)."""
-    from janus_tpu.engine import fused_init as fi
-
-    calls = []
-    orig = fi.FusedHelperInit.run
-
-    def spy(self, *a, **k):
-        calls.append(1)
-        return orig(self, *a, **k)
-
-    fi.FusedHelperInit.run = spy
-    try:
-        _run(VdafInstance.prio3_count(), fused=False)  # floor = 1e9
-        assert not calls
-    finally:
-        fi.FusedHelperInit.run = orig
+    _resp, _agg, spy = _run(VdafInstance.prio3_count(), fused=False)
+    assert spy.calls == 0
