@@ -27,6 +27,60 @@ from janus_tpu.vdaf.poplar1 import Poplar1
 from janus_tpu.vdaf.prio3 import PrepShare, PrepState, VdafError
 
 
+class _PreEncodedMessage:
+    """Stands in for a PingPongMessage whose wire bytes were assembled
+    columnar.  The hot consumer only calls .encode(); anything touching
+    the structured fields (tests, in-process drivers) triggers a lazy
+    decode through the real codec."""
+
+    __slots__ = ("_data", "_msg")
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self._msg = None
+
+    def encode(self) -> bytes:
+        return self._data
+
+    def _decoded(self):
+        if self._msg is None:
+            self._msg = ping_pong.PingPongMessage.decode(self._data)
+        return self._msg
+
+    @property
+    def type(self):
+        return self._data[0]
+
+    @property
+    def prep_msg(self):
+        return self._decoded().prep_msg
+
+    @property
+    def prep_share(self):
+        return self._decoded().prep_share
+
+
+class _LazyContinued:
+    """PingPongContinued stand-in: the fast path keeps only the encoded
+    prep state; tests/drivers that walk .prep_state decode on demand."""
+
+    __slots__ = ("_vdaf", "_bytes", "_state")
+
+    finished = False
+    current_round = 1
+
+    def __init__(self, vdaf, state_bytes: bytes):
+        self._vdaf = vdaf
+        self._bytes = state_bytes
+        self._state = None
+
+    @property
+    def prep_state(self):
+        if self._state is None:
+            self._state, _rnd = self._vdaf.decode_prep_state(self._bytes)
+        return self._state
+
+
 class _CachedPrepVdaf:
     """Delegating vdaf whose prep_init returns a precomputed result —
     lets the oracle ping-pong code drive device-computed preparations."""
@@ -75,6 +129,55 @@ class BatchPoplar1(HostPrepEngine):
         # leaf (ops/field255.py + eval_leaf_level) since round 3
         return len(prefixes) > 0
 
+    def _sketch_body(self, N: int, P: int, level: int, party: bool):
+        """The shared IDPF-walk + sketch trace: ONE definition consumed by
+        both the oracle-framing kernel (_precompute) and the fused fast
+        kernel (_helper_fast_fn), so the two jitted paths cannot drift.
+
+        Returns a traced closure -> (ys [L,P,N], abc [L,3,N], r1 [L,3,N],
+        rej [N]); `offs` is None for the helper (its share carries no
+        offsets — poplar1.py encode_input_share)."""
+        import jax.numpy as jnp
+
+        from janus_tpu.ops import field64 as f64
+        from janus_tpu.ops import field255 as f255
+        from janus_tpu.ops import xof_batch
+        from janus_tpu.ops.idpf_batch import eval_inner_level, eval_leaf_level
+
+        leaf = level == self.vdaf.bits - 1
+        fops = f255 if leaf else f64
+        expand = (xof_batch.expand_field255 if leaf
+                  else xof_batch.expand_field64)
+        binder_static = level.to_bytes(2, "big") + P.to_bytes(4, "big")
+
+        def body(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
+                 corr_seeds, nonce_rows, pb, offs=None):
+            parties = jnp.full((N,), party, dtype=bool)
+            if leaf:
+                ys, rej0 = eval_leaf_level(
+                    fixed, seeds, parties, cw_seeds, cw_ctrls, payload,
+                    pb, level, P)
+            else:
+                ys = eval_inner_level(fixed, seeds, parties, cw_seeds,
+                                      cw_ctrls, payload, pb, level, P)
+                rej0 = jnp.zeros((N,), dtype=bool)
+            rs, rej1 = expand(
+                (N,), [xof_batch.xof_prefix(b"poplar1 query"), vk_rows,
+                       nonce_rows, binder_static], P)
+            corr, rej2 = expand(
+                (N,), [xof_batch.xof_prefix(b"poplar1 corr"), corr_seeds,
+                       level.to_bytes(2, "big")], 3)
+            abc = fops.add(corr, offs) if offs is not None else corr
+            a_s, c_s = abc[:, 0], abc[:, 2]
+            z = fops.sum_mod(fops.mul(rs, ys), axis=-2)
+            zs = fops.sum_mod(fops.mul(fops.mul(rs, rs), ys), axis=-2)
+            zc = fops.sum_mod(ys, axis=-2)
+            r1 = jnp.stack(
+                [fops.add(z, a_s), fops.add(zs, c_s), zc], axis=1)
+            return ys, abc, r1, rej0 | rej1 | rej2
+
+        return body
+
     def _precompute(self, verify_key: bytes, agg_id: int, nonces, decoded):
         """Device batch over all decodable reports.
 
@@ -96,7 +199,12 @@ class BatchPoplar1(HostPrepEngine):
         P = len(prefixes)
         leaf = level == self.vdaf.bits - 1
         L = 8 if leaf else 2  # u32 limbs per element (Field255 / Field64)
-        idx = [i for i, d in enumerate(decoded) if d is not None]
+        # Lanes whose IDPF key carries the wrong party byte go to the host
+        # oracle (which honors key.party and so rejects them through the
+        # sketch, exactly as the un-batched path would): the kernel bakes
+        # the party in statically.
+        idx = [i for i, d in enumerate(decoded)
+               if d is not None and d[0].party == agg_id]
         if not idx:
             return [None] * len(decoded)
         from janus_tpu.engine.batch import bucket_size
@@ -143,37 +251,12 @@ class BatchPoplar1(HostPrepEngine):
         if fn is None:
             import jax
 
-            binder_static = (level.to_bytes(2, "big")
-                            + P.to_bytes(4, "big"))
-            fops = f255 if leaf else f64
-            expand = (xof_batch.expand_field255 if leaf
-                      else xof_batch.expand_field64)
+            body = self._sketch_body(N, P, level, party)
 
             def kernel(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
                        corr_seeds, offs, nonce_rows, pb):
-                parties = jnp.full((N,), party, dtype=bool)
-                if leaf:
-                    ys, rej0 = eval_leaf_level(
-                        fixed, seeds, parties, cw_seeds, cw_ctrls, payload,
-                        pb, level, P)
-                else:
-                    ys = eval_inner_level(fixed, seeds, parties, cw_seeds,
-                                          cw_ctrls, payload, pb, level, P)
-                    rej0 = jnp.zeros((N,), dtype=bool)
-                rs, rej1 = expand(
-                    (N,), [xof_batch.xof_prefix(b"poplar1 query"), vk_rows,
-                           nonce_rows, binder_static], P)
-                corr, rej2 = expand(
-                    (N,), [xof_batch.xof_prefix(b"poplar1 corr"), corr_seeds,
-                           level.to_bytes(2, "big")], 3)
-                abc = fops.add(corr, offs)  # [L, 3, N]
-                a_s, c_s = abc[:, 0], abc[:, 2]
-                z = fops.sum_mod(fops.mul(rs, ys), axis=-2)
-                zs = fops.sum_mod(fops.mul(fops.mul(rs, rs), ys), axis=-2)
-                zc = fops.sum_mod(ys, axis=-2)
-                r1 = jnp.stack(
-                    [fops.add(z, a_s), fops.add(zs, c_s), zc], axis=1)
-                return ys, abc, r1, rej0 | rej1 | rej2
+                return body(vk_rows, fixed, seeds, cw_seeds, cw_ctrls,
+                            payload, corr_seeds, nonce_rows, pb, offs)
 
             fn = jax.jit(kernel)
             self._fns[fn_key] = fn
@@ -214,40 +297,268 @@ class BatchPoplar1(HostPrepEngine):
             out[i] = (state, share)
         return out
 
+    # -- columnar helper fast path ----------------------------------------
+
+    def _helper_share_layout(self, level: int):
+        """Byte offsets inside the HELPER input share (corr_seed ||
+        IdpfKey; agg_id=1 carries no offsets — poplar1.py
+        encode_input_share).  Everything is fixed-length given `bits`."""
+        b = self.vdaf.bits
+        cw_start = 33  # corr(16) + party(1) + seed(16)
+        pcs = cw_start + 17 * b
+        pcw_off = pcs + 8 * level  # levels < bits-1 are Field64 (8 B)
+        total = pcs + 8 * (b - 1) + 32
+        return cw_start, pcw_off, total
+
+    def _helper_fast_fn(self, N: int, P: int, level: int):
+        """One device program for the WHOLE helper round-0: IDPF walk +
+        sketch + combine with the leader's round-1 share + the round-2
+        sigma share (prep_shares_to_prep + prep_next fused), returning a
+        single bundle so the host pays ONE result fetch.
+
+        Bundle [L, 8+P, N]: abc(3) | combined(3) | sigma(1) | flags(1) |
+        ys(P); flags limb0: bit0 = XOF rejection, bit1 = ZC not in {0,1}."""
+        fn_key = ("hfast", N, P, level)
+        fn = self._fns.get(fn_key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from janus_tpu.ops import field64 as f64
+        from janus_tpu.ops import field255 as f255
+
+        leaf = level == self.vdaf.bits - 1
+        fops = f255 if leaf else f64
+        body = self._sketch_body(N, P, level, party=True)  # helper
+
+        def kernel(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
+                   corr_seeds, nonce_rows, pb, leader_r1):
+            ys, abc, r1, rej = body(vk_rows, fixed, seeds, cw_seeds,
+                                    cw_ctrls, payload, corr_seeds,
+                                    nonce_rows, pb)
+            a_s, b_s, c_s = abc[:, 0], abc[:, 1], abc[:, 2]
+            combined = fops.add(r1, leader_r1)           # [L, 3, N]
+            zcmb = combined[:, 2]
+            one = jnp.zeros_like(zcmb).at[0].set(jnp.uint32(1))
+            zc_ok = (jnp.all(zcmb == 0, axis=0)
+                     | jnp.all(zcmb == one, axis=0))
+            zp = combined[:, 0]
+            # helper sigma share: (b + c) - 2*Z'*a  (poplar1.prep_next)
+            sigma = fops.sub(fops.add(b_s, c_s),
+                             fops.mul(fops.add(zp, zp), a_s))
+            bad = rej.astype(jnp.uint32) \
+                | ((~zc_ok).astype(jnp.uint32) << 1)
+            flags = jnp.zeros((ys.shape[0], 1, N), dtype=jnp.uint32)
+            flags = flags.at[0, 0].set(bad)
+            bundle = jnp.concatenate(
+                [abc, combined, sigma[:, None], flags, ys], axis=1)
+            return bundle
+
+        fn = jax.jit(kernel)
+        self._fns[fn_key] = fn
+        return fn
+
     # -- engine surface ----------------------------------------------------
 
     def helper_init_batch(self, verify_key, nonces, public_shares,
                           input_shares, inbound_messages):
         if not self._device_eligible() or len(nonces) < self.device_min_batch:
-            return super().helper_init_batch(
+            return self._helper_init_oracle(
                 verify_key, nonces, public_shares, input_shares,
-                inbound_messages)
+                inbound_messages, range(len(nonces)))
+        from janus_tpu.engine.batch import PreparedReport, bucket_size
+
+        level, prefixes = self.vdaf._bound()
+        P = len(prefixes)
+        leaf = level == self.vdaf.bits - 1
+        L = 8 if leaf else 2
+        es = 4 * L
+        n = len(nonces)
+        cw_start, pcw_off, share_len = self._helper_share_layout(level)
+        n_levels = level + 1
+
+        # Per-lane admission: uniform fixed lengths + an initialize message
+        # of the right size + empty public share; anything else (and, after
+        # the kernel, any flagged lane) re-runs through the host oracle so
+        # error strings stay bit-identical to the un-batched path.
+        slow: list[int] = []
+        fast: list[int] = []
+        for i in range(n):
+            msg = inbound_messages[i]
+            if (len(input_shares[i]) != share_len or public_shares[i]
+                    or msg.type != ping_pong.PingPongMessage.TYPE_INITIALIZE
+                    or msg.prep_share is None
+                    or len(msg.prep_share) != 3 * es):
+                slow.append(i)
+            else:
+                fast.append(i)
+        out: list = [None] * n
+        if fast:
+            arr = np.frombuffer(
+                b"".join(input_shares[i] for i in fast),
+                dtype=np.uint8).reshape(len(fast), share_len)
+            # the kernel bakes party=1 in statically; a share claiming the
+            # wrong party must go through the host oracle (which honors
+            # key.party, so the sketch rejects it like the un-batched path)
+            party_ok = arr[:, 16] == 1
+            if not bool(party_ok.all()):
+                keep = np.flatnonzero(party_ok)
+                slow.extend(fast[j] for j in np.flatnonzero(~party_ok))
+                fast = [fast[j] for j in keep.tolist()]
+                arr = arr[keep]
+        if fast:
+            k = len(fast)
+            N = bucket_size(k)
+            sec = arr[:, cw_start:cw_start + 17 * self.vdaf.bits].reshape(
+                k, self.vdaf.bits, 17)[:, :n_levels]
+            cw_seeds = np.zeros((n_levels, N, 16), dtype=np.uint8)
+            cw_seeds[:, :k] = sec[:, :, :16].transpose(1, 0, 2)
+            cw_ctrls = np.zeros((n_levels, N, 2), dtype=np.uint8)
+            ctrl = sec[:, :, 16]
+            cw_ctrls[:, :k, 0] = (ctrl & 1).T
+            cw_ctrls[:, :k, 1] = ((ctrl >> 1) & 1).T
+            seeds = np.zeros((N, 16), dtype=np.uint8)
+            seeds[:k] = arr[:, 17:33]
+            corr_seeds = np.zeros((N, 16), dtype=np.uint8)
+            corr_seeds[:k] = arr[:, :16]
+            payload = np.zeros((L, N), dtype=np.uint32)
+            payload[:, :k] = np.ascontiguousarray(
+                arr[:, pcw_off:pcw_off + es]).view("<u4").T
+            fixed = np.zeros((N, 16), dtype=np.uint8)
+            fixed[:k] = np.frombuffer(
+                b"".join(_idpf._fixed_key(nonces[i], b"janus-tpu idpf")
+                         for i in fast), dtype=np.uint8).reshape(k, 16)
+            nonce_rows = np.zeros((N, 16), dtype=np.uint8)
+            nonce_rows[:k] = np.frombuffer(
+                b"".join(nonces[i] for i in fast),
+                dtype=np.uint8).reshape(k, 16)
+            lr1 = np.zeros((N, 3, L), dtype=np.uint32)
+            lr1[:k] = np.frombuffer(
+                b"".join(inbound_messages[i].prep_share for i in fast),
+                dtype="<u4").reshape(k, 3, L)
+            # leader elements must be canonical for the field kernels; the
+            # oracle's plain modular arithmetic accepts any bytes, so
+            # non-canonical lanes (adversarial) take the oracle path
+            gt = np.zeros((k, 3), dtype=bool)
+            eq = np.ones((k, 3), dtype=bool)
+            mod = self.vdaf._field(level).MODULUS
+            for j in range(L - 1, -1, -1):
+                c = np.uint32((mod >> (32 * j)) & 0xFFFFFFFF)
+                gt |= eq & (lr1[:k, :, j] > c)
+                eq &= lr1[:k, :, j] == c
+            in_range = ~((gt | eq).any(axis=1))
+
+            from janus_tpu.ops.idpf_batch import pack_prefix_bits
+
+            pb = pack_prefix_bits(prefixes, level, n_levels)
+            vk_rows = np.broadcast_to(
+                np.frombuffer(verify_key, dtype=np.uint8),
+                (N, len(verify_key)))
+            fn = self._helper_fast_fn(N, P, level)
+            bundle = np.asarray(fn(
+                vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
+                corr_seeds, nonce_rows, pb,
+                np.ascontiguousarray(lr1.transpose(2, 1, 0))))
+            flags = bundle[0, 7, :k]
+
+            # columnar encodes (one pass each, no per-report bigints):
+            # persisted state = round(1) | agg_id(1) | a,b,c | ys...
+            state_cols = np.concatenate(
+                [bundle[:, 0:3, :k], bundle[:, 8:8 + P, :k]], axis=1)
+            state_blob = np.ascontiguousarray(
+                state_cols.transpose(2, 1, 0)).astype("<u4").tobytes()
+            srow = (3 + P) * es
+            # outbound CONTINUE = 0x01 | u32 len | prep_msg(3 elems) |
+            # u32 len | sigma
+            ob = np.zeros((k, 1 + 4 + 3 * es + 4 + es), dtype=np.uint8)
+            ob[:, 0] = 1
+            ob[:, 1:5] = np.frombuffer(
+                (3 * es).to_bytes(4, "big"), np.uint8)
+            ob[:, 5:5 + 3 * es] = np.ascontiguousarray(
+                bundle[:, 3:6, :k].transpose(2, 1, 0)).astype(
+                "<u4").view(np.uint8).reshape(k, 3 * es)
+            ob[:, 5 + 3 * es:9 + 3 * es] = np.frombuffer(
+                es.to_bytes(4, "big"), np.uint8)
+            ob[:, 9 + 3 * es:] = np.ascontiguousarray(
+                bundle[:, 6:7, :k].transpose(2, 1, 0)).astype(
+                "<u4").view(np.uint8).reshape(k, es)
+            ob_blob = ob.tobytes()
+            obrow = ob.shape[1]
+            hdr = bytes([1, 1])
+            flags_l = flags.tolist()
+            in_range_l = in_range.tolist()
+            for j, i in enumerate(fast):
+                if not in_range_l[j]:
+                    slow.append(i)
+                    continue
+                f = flags_l[j]
+                if f & 1:  # XOF rejection: host fallback lane
+                    self.fallback_count += 1
+                    slow.append(i)
+                    continue
+                if f & 2:
+                    out[i] = PreparedReport(
+                        "failed", error="Poplar1 count check failed")
+                    continue
+                sb = hdr + state_blob[j * srow:(j + 1) * srow]
+                out[i] = PreparedReport(
+                    "continued",
+                    outbound=_PreEncodedMessage(
+                        ob_blob[j * obrow:(j + 1) * obrow]),
+                    state=_LazyContinued(self.vdaf, sb),
+                    prep_share=sb)
+        if slow:
+            slow_res = self._helper_init_oracle(
+                verify_key, nonces, public_shares, input_shares,
+                inbound_messages, sorted(slow))
+            for i, rep in zip(sorted(slow), slow_res):
+                out[i] = rep
+        return out
+
+    def _helper_init_oracle(self, verify_key, nonces, public_shares,
+                            input_shares, inbound_messages, lanes):
+        """The pre-columnar path (device _precompute + per-report oracle
+        framing) over `lanes`; also the semantic reference for the fast
+        path, kept in lockstep by tests/test_idpf_batch.py."""
         from janus_tpu.engine.batch import PreparedReport
 
+        lanes = list(lanes)
+        use_device = (self._device_eligible()
+                      and len(lanes) >= self.device_min_batch)
+        if not use_device:
+            return super().helper_init_batch(
+                verify_key, [nonces[i] for i in lanes],
+                [public_shares[i] for i in lanes],
+                [input_shares[i] for i in lanes],
+                [inbound_messages[i] for i in lanes])
         decoded = []
         errors: dict[int, str] = {}
-        for i, (pub, in_bytes) in enumerate(zip(public_shares, input_shares)):
+        for i in lanes:
             try:
-                self.vdaf.decode_public_share(pub)
-                decoded.append(self.vdaf.decode_input_share(1, in_bytes))
+                self.vdaf.decode_public_share(public_shares[i])
+                decoded.append(self.vdaf.decode_input_share(
+                    1, input_shares[i]))
             except (VdafError, ValueError, AssertionError) as e:
                 errors[i] = str(e)
                 decoded.append(None)
-        cached = self._precompute(verify_key, 1, nonces, decoded)
+        cached = self._precompute(
+            verify_key, 1, [nonces[i] for i in lanes], decoded)
         out = []
-        for i, inbound in enumerate(inbound_messages):
+        for j, i in enumerate(lanes):
+            inbound = inbound_messages[i]
             if i in errors:
                 out.append(PreparedReport("failed", error=errors[i]))
                 continue
-            if cached[i] is None:
+            if cached[j] is None:
                 out.extend(super().helper_init_batch(
-                    verify_key, nonces[i : i + 1], public_shares[i : i + 1],
-                    input_shares[i : i + 1], [inbound]))
+                    verify_key, nonces[i: i + 1], public_shares[i: i + 1],
+                    input_shares[i: i + 1], [inbound]))
                 continue
-            shim = _CachedPrepVdaf(self.vdaf, cached[i])
+            shim = _CachedPrepVdaf(self.vdaf, cached[j])
             try:
                 transition = ping_pong.helper_initialized(
-                    shim, verify_key, nonces[i], b"", decoded[i], inbound)
+                    shim, verify_key, nonces[i], b"", decoded[j], inbound)
                 state, outbound = transition.evaluate()
                 if state.finished:
                     out.append(PreparedReport(

@@ -229,3 +229,43 @@ def test_batch_poplar1_leaf_level_on_device():
         alpha_prefix = ((i * 5) % 16) >> (4 - 1 - level)
         want = [1 if p == alpha_prefix else 0 for p in prefixes]
         assert combined == want
+
+
+def test_party_byte_mismatch_matches_oracle():
+    """A helper share whose embedded IDPF party byte claims the wrong
+    party must be treated identically by the batched fast path and the
+    host oracle: the kernels bake the party in statically, so such lanes
+    must route to the oracle (which honors key.party) rather than be
+    evaluated under the wrong party."""
+    vdaf = new_poplar1(4)
+    level, prefixes = 3, [0, 5, 9, 15]
+    ap = encode_agg_param(level, prefixes)
+    verify_key = bytes(range(16))
+    host = HostPrepEngine(vdaf).bind(ap)
+    dev = BatchPoplar1(vdaf, device_min_batch=1).bind(ap)
+
+    nonces, pubs, shares1, inits = [], [], [], []
+    for i in range(6):
+        nonce = (i + 1).to_bytes(16, "big")
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard((i * 5) % 16, nonce, rand)
+        _st, msg = ping_pong.leader_initialized(
+            vdaf.with_agg_param(ap), verify_key,
+            nonce, pub, ishares[0])
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        enc = bytearray(vdaf.encode_input_share(1, ishares[1]))
+        if i in (1, 4):
+            enc[16] ^= 1  # flip the IdpfKey party byte
+        shares1.append(bytes(enc))
+        inits.append(msg)
+
+    res_d = dev.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    res_h = host.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    for a, b in zip(res_d, res_h):
+        assert a.status == b.status
+        if a.status == "continued":
+            assert a.outbound.encode() == b.outbound.encode()
+            assert a.prep_share == b.prep_share
+        else:
+            assert a.error == b.error
