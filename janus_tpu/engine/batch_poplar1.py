@@ -11,9 +11,14 @@ stays on the host, driven through the UNMODIFIED oracle code via a shim vdaf
 whose `prep_init` returns the device-computed (state, round-1 share).  That
 keeps the wire behavior bit-identical to the oracle by construction.
 
-Device path: inner levels (Field64).  The leaf level (Field255 payloads)
-falls back to the host oracle per report, as does any report whose XOF
-sampling hit a rejection (~2^-32 per sampled element).
+Device path: EVERY level, including the Field255 leaf (ops/field255.py +
+eval_leaf_level, since round 3).  For the HELPER, the whole round —
+walk, sketch, combine with the leader's round-1 share, the ZC count
+check, and the round-2 sigma share — is ONE fused kernel whose outputs
+are framed columnar (helper_init_batch below); the oracle-shim path
+remains for the leader side, sub-batch requests, and per-lane anomalies
+(wrong lengths/party byte, non-canonical leader elements, XOF rejections
+at ~2^-32 per sampled element).
 """
 
 from __future__ import annotations
@@ -493,8 +498,8 @@ class BatchPoplar1(HostPrepEngine):
                     slow.append(i)
                     continue
                 f = flags_l[j]
-                if f & 1:  # XOF rejection: host fallback lane
-                    self.fallback_count += 1
+                if f & 1:  # XOF rejection: host fallback lane (the oracle
+                    # path it reroutes through counts the fallback)
                     slow.append(i)
                     continue
                 if f & 2:

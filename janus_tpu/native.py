@@ -325,3 +325,69 @@ def checksum_report_ids(ids: bytes, seed: bytes = bytes(32)):
         ids, len(ids) // 16,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out.tobytes()
+
+
+# -- Prio3 single-core baseline (native/prio3_baseline.cpp) -----------------
+
+_baseline_lib = None
+_baseline_tried = False
+
+
+def _load_baseline():
+    global _baseline_lib, _baseline_tried
+    with _lock:
+        if _baseline_lib is not None or _baseline_tried:
+            return _baseline_lib
+        _baseline_tried = True
+        path = _build("prio3_baseline")
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.p3sv_helper_prepare.restype = ctypes.c_int
+            lib.p3sv_helper_prepare.argtypes = [
+                ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+            lib.p3sv_helper_bench.restype = ctypes.c_double
+            lib.p3sv_helper_bench.argtypes = [
+                ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32]
+            _baseline_lib = lib
+        except OSError:
+            _baseline_lib = None
+        return _baseline_lib
+
+
+def baseline_available() -> bool:
+    return _load_baseline() is not None
+
+
+def prio3_baseline_prepare(length: int, chunk: int, vk: bytes, nonce: bytes,
+                           seed: bytes, blind: bytes, leader_part: bytes,
+                           verifier_len: int):
+    """Independent C++ Prio3SumVec helper prepare -> (prep share bytes,
+    joint rand seed) or None.  Correctness anchor: see
+    native/prio3_baseline.cpp and tests/test_native_baseline.py."""
+    lib = _load_baseline()
+    if lib is None:
+        return None
+    # buffer capacity from the C side's own geometry (2 + 2*chunk verifier
+    # elements), NOT the caller's verifier_len: the C function writes its
+    # full output before the rc check could reject a mismatch
+    cap_elems = 2 + 2 * chunk
+    out = ctypes.create_string_buffer(16 + 16 * max(cap_elems, verifier_len))
+    jr = ctypes.create_string_buffer(16)
+    rc = lib.p3sv_helper_prepare(length, chunk, vk, nonce, seed, blind,
+                                 leader_part, out, jr)
+    if rc != verifier_len:
+        return None
+    return out.raw[:16 + 16 * verifier_len], jr.raw
+
+
+def prio3_baseline_bench(length: int, chunk: int, iters: int) -> float | None:
+    """Single-core helper-prepare rate of the independent C++
+    implementation (BASELINE.md's native comparator)."""
+    lib = _load_baseline()
+    if lib is None:
+        return None
+    return float(lib.p3sv_helper_bench(length, chunk, iters))

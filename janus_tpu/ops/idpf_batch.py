@@ -15,8 +15,11 @@ prio's poplar1 module (consumed via core/src/vdaf.rs:95); here the whole
   as trace-time plane masks — no hashes, no counter carries, no gathers.
 - Seed/control correction words, child selection by prefix bit, and the
   final payload correction are masked XOR/field ops in plane space.
-- Only INNER levels (Field64 payloads) run on device; the leaf level
-  (Field255) takes the host oracle path in the engine.
+- EVERY level runs on device: inner levels via eval_inner_level (Field64
+  payloads) and the leaf via eval_leaf_level (Field255, ops/field255.py
+  with oversampled rejection sampling; lanes that exhaust the
+  oversampling margin — probability ~2^-32 per element — flag for the
+  engine's per-lane host fallback).
 
 Field64 candidates never reject (the oracle clears the top bit of each
 8-byte chunk, and 2^63 < p), so the walk output is bit-exact with the

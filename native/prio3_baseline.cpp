@@ -1,0 +1,450 @@
+// Single-core C++ Prio3SumVec helper prepare: the native baseline AND an
+// independent correctness anchor for the VDAF math.
+//
+// Provenance discipline: the field arithmetic (128-bit Montgomery CIOS),
+// the iterative NTT, the Keccak-p[1600,12] permutation, and the FLP query
+// evaluation below are written from the underlying mathematical
+// definitions, NOT transliterated from the Python oracle (which is
+// recursive / big-int based).  Wire-level protocol constants — the XOF
+// message framing (len(dst) || dst || seed || binder, TurboSHAKE domain
+// 0x01), the Prio3 domain-separation tag layout, and the SumVec circuit
+// shape (ParallelSum of Mul over chunks, weights r^1..r^c, 1/shares
+// offset) — are protocol facts shared with the Python by necessity.
+// tests/test_native_baseline.py cross-checks this implementation against
+// the Python oracle bit-exactly: agreement is evidence both implement the
+// same function, from two structurally different codebases.
+//
+// Reference behavior: the prio crate's Prio3 prepare consumed by the
+// reference at core/src/vdaf.rs:68 (Prio3SumVec), whose per-report CPU
+// cost is what BASELINE.md's ">= 100x single core" row measures against.
+//
+// Build: g++ -O2 -shared -fPIC -o libprio3baseline.so prio3_baseline.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <chrono>
+#include <vector>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+typedef uint8_t u8;
+
+// ---------------------------------------------------------------------------
+// Field128: p = 2^66 * (2^62 - 7) + 1 = 0xFFFFFFFFFFFFFFE4_0000000000000001
+// Elements are plain u128 residues; multiplication runs through a 2-word
+// Montgomery CIOS (p === 1 mod 2^64, so n' = -p^{-1} = 2^64 - 1).
+// ---------------------------------------------------------------------------
+
+static const u64 P_HI = 0xFFFFFFFFFFFFFFE4ull;
+static const u64 P_LO = 0x0000000000000001ull;
+static inline u128 P() { return ((u128)P_HI << 64) | P_LO; }
+
+static inline u128 fadd(u128 a, u128 b) {
+    u128 r = a + b;
+    if (r < a) return r + (((u128)0 - P()));  // wrapped: add 2^128 - p
+    if (r >= P()) r -= P();
+    return r;
+}
+
+static inline u128 fsub(u128 a, u128 b) {
+    return (a >= b) ? a - b : a + (P() - b);
+}
+
+// 2-word Montgomery multiply: returns a*b*R^{-1} mod p, R = 2^128.
+static inline u128 mont_mul(u128 a, u128 b) {
+    u64 a0 = (u64)a, a1 = (u64)(a >> 64);
+    u64 b0 = (u64)b, b1 = (u64)(b >> 64);
+    // t = a * b, 4 words
+    u128 m00 = (u128)a0 * b0;
+    u128 m01 = (u128)a0 * b1;
+    u128 m10 = (u128)a1 * b0;
+    u128 m11 = (u128)a1 * b1;
+    u64 t0 = (u64)m00;
+    u128 c = (m00 >> 64) + (u64)m01 + (u64)m10;
+    u64 t1 = (u64)c;
+    c = (c >> 64) + (m01 >> 64) + (m10 >> 64) + (u64)m11;
+    u64 t2 = (u64)c;
+    u64 t3 = (u64)(c >> 64) + (u64)(m11 >> 64);
+    // 2 reduction rounds; n' = 2^64-1 so m = t0 * n' = -t0 mod 2^64
+    for (int i = 0; i < 2; i++) {
+        u64 m = (u64)(0 - (u128)t0);
+        // t += m * p; p = (P_HI, P_LO=1)
+        u128 s = (u128)m * P_LO + t0;          // low word -> 0 mod 2^64
+        u128 carry = s >> 64;
+        s = (u128)m * P_HI + t1 + carry;
+        u64 n1 = (u64)s;
+        carry = s >> 64;
+        s = (u128)t2 + carry;
+        u64 n2 = (u64)s;
+        u64 n3 = t3 + (u64)(s >> 64);
+        // shift right one word
+        t0 = n1; t1 = n2; t2 = n3; t3 = 0;
+    }
+    u128 r = ((u128)t1 << 64) | t0;
+    if (t2 || r >= P()) r -= P();  // t2 can be at most 1
+    return r;
+}
+
+struct Fp {
+    u128 v;  // Montgomery form
+};
+
+static u128 R2;        // R^2 mod p
+static Fp F_ONE;       // 1 in Montgomery form
+static Fp SHARES_INV;  // 1/2 in Montgomery form
+
+static inline Fp to_mont(u128 x) { return Fp{mont_mul(x % P(), R2)}; }
+static inline u128 from_mont(Fp x) { return mont_mul(x.v, 1); }
+static inline Fp fmul(Fp a, Fp b) { return Fp{mont_mul(a.v, b.v)}; }
+static inline Fp fadd(Fp a, Fp b) { return Fp{fadd(a.v, b.v)}; }
+static inline Fp fsub(Fp a, Fp b) { return Fp{fsub(a.v, b.v)}; }
+
+static Fp fpow(Fp base, u128 e) {
+    Fp acc = F_ONE;
+    while (e) {
+        if (e & 1) acc = fmul(acc, base);
+        base = fmul(base, base);
+        e >>= 1;
+    }
+    return acc;
+}
+
+static inline Fp finv(Fp x) { return fpow(x, P() - 2); }
+
+static void field_init() {
+    // R = 2^128 mod p = 2^128 - p; R2 by 128 modular doublings of R
+    u128 r = (u128)0 - P();
+    u128 r2 = r;
+    for (int i = 0; i < 128; i++) r2 = fadd(r2, r2);
+    R2 = r2;
+    F_ONE = Fp{r};  // 1*R mod p
+    SHARES_INV = finv(to_mont(2));
+}
+
+// GENERATOR = 7^((p-1) >> 66); primitive 2^66-th root of unity
+static Fp root_of_unity(u64 n_pow2) {
+    Fp g7 = to_mont(7);
+    u128 e = (P() - 1) >> 66;
+    Fp gen = fpow(g7, e);  // order 2^66
+    // gen^(2^66 / n)
+    u64 log_n = 0;
+    while (((u64)1 << log_n) < n_pow2) log_n++;
+    for (u64 i = 0; i < 66 - log_n; i++) gen = fmul(gen, gen);
+    return gen;
+}
+
+// ---------------------------------------------------------------------------
+// Iterative radix-2 NTT (decimation in time, bit-reversed input ordering) —
+// evaluates/interpolates on the powers of an n-th root in natural order.
+// ---------------------------------------------------------------------------
+
+static void ntt_inplace(std::vector<Fp>& a, Fp w) {
+    size_t n = a.size();
+    // bit reversal
+    for (size_t i = 1, j = 0; i < n; i++) {
+        size_t bit = n >> 1;
+        for (; j & bit; bit >>= 1) j ^= bit;
+        j ^= bit;
+        if (i < j) std::swap(a[i], a[j]);
+    }
+    for (size_t len = 2; len <= n; len <<= 1) {
+        Fp wl = w;
+        for (size_t l = len; l < n; l <<= 1) wl = fmul(wl, wl);
+        for (size_t i = 0; i < n; i += len) {
+            Fp cur = F_ONE;
+            for (size_t j = 0; j < len / 2; j++) {
+                Fp u = a[i + j];
+                Fp t = fmul(cur, a[i + j + len / 2]);
+                a[i + j] = fadd(u, t);
+                a[i + j + len / 2] = fsub(u, t);
+                cur = fmul(cur, wl);
+            }
+        }
+    }
+}
+
+// interpolate coefficients from evaluations at w^0..w^{n-1}
+static void intt(std::vector<Fp>& a, Fp w) {
+    ntt_inplace(a, finv(w));
+    Fp inv_n = finv(to_mont((u128)a.size()));
+    for (auto& x : a) x = fmul(x, inv_n);
+}
+
+static Fp poly_eval(const std::vector<Fp>& c, Fp x) {
+    Fp acc = Fp{0};
+    for (size_t i = c.size(); i-- > 0;) acc = fadd(fmul(acc, x), c[i]);
+    return acc;
+}
+
+// ---------------------------------------------------------------------------
+// Keccak-p[1600,12] / TurboSHAKE128 (rate 168, domain byte 0x01)
+// ---------------------------------------------------------------------------
+
+static const u64 RC[24] = {
+    0x0000000000000001ull, 0x0000000000008082ull, 0x800000000000808aull,
+    0x8000000080008000ull, 0x000000000000808bull, 0x0000000080000001ull,
+    0x8000000080008081ull, 0x8000000000008009ull, 0x000000000000008aull,
+    0x0000000000000088ull, 0x0000000080008009ull, 0x000000008000000aull,
+    0x000000008000808bull, 0x800000000000008bull, 0x8000000000008089ull,
+    0x8000000000008003ull, 0x8000000000008002ull, 0x8000000000000080ull,
+    0x000000000000800aull, 0x800000008000000aull, 0x8000000080008081ull,
+    0x8000000000008080ull, 0x0000000080000001ull, 0x8000000080008008ull};
+
+static const int ROT[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3,  10, 43,
+                            25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56, 14};
+
+static inline u64 rotl64(u64 v, int n) {
+    return n ? (v << n) | (v >> (64 - n)) : v;
+}
+
+static void keccak_p12(u64 s[25]) {
+    for (int round = 12; round < 24; round++) {
+        u64 bc[5], t;
+        // theta
+        for (int i = 0; i < 5; i++)
+            bc[i] = s[i] ^ s[i + 5] ^ s[i + 10] ^ s[i + 15] ^ s[i + 20];
+        for (int i = 0; i < 5; i++) {
+            t = bc[(i + 4) % 5] ^ rotl64(bc[(i + 1) % 5], 1);
+            for (int j = 0; j < 25; j += 5) s[j + i] ^= t;
+        }
+        // rho + pi
+        u64 b[25];
+        for (int x = 0; x < 5; x++)
+            for (int y = 0; y < 5; y++) {
+                int src = x + 5 * y;
+                int dst = y + 5 * ((2 * x + 3 * y) % 5);
+                b[dst] = rotl64(s[src], ROT[src]);
+            }
+        // chi
+        for (int j = 0; j < 25; j += 5)
+            for (int i = 0; i < 5; i++)
+                s[j + i] = b[j + i] ^ ((~b[j + (i + 1) % 5]) & b[j + (i + 2) % 5]);
+        // iota
+        s[0] ^= RC[round];
+    }
+}
+
+struct Turbo {
+    u64 lanes[25];
+    u8 buf[168];
+    size_t have;  // bytes available in buf
+
+    void init(const u8* msg, size_t len) {
+        memset(lanes, 0, sizeof lanes);
+        // absorb msg || 0x01 domain, zero pad to rate, last byte ^= 0x80
+        size_t padded = ((len + 1 + 167) / 168) * 168;
+        std::vector<u8> p(padded, 0);
+        memcpy(p.data(), msg, len);
+        p[len] = 0x01;
+        p[padded - 1] ^= 0x80;
+        for (size_t off = 0; off < padded; off += 168) {
+            for (int i = 0; i < 21; i++) {
+                u64 lane;
+                memcpy(&lane, &p[off + 8 * i], 8);
+                lanes[i] ^= lane;
+            }
+            keccak_p12(lanes);
+        }
+        have = 0;
+    }
+
+    void refill() {
+        memcpy(buf, lanes, 168);
+        keccak_p12(lanes);
+        have = 168;
+    }
+
+    void squeeze(u8* out, size_t n) {
+        while (n) {
+            if (!have) refill();
+            size_t take = n < have ? n : have;
+            memcpy(out, buf + (168 - have), take);
+            out += take;
+            have -= take;
+            n -= take;
+        }
+    }
+
+    // rejection-sample a Field128 element (16 bytes LE, < p)
+    Fp next_fe() {
+        for (;;) {
+            u8 b[16];
+            squeeze(b, 16);
+            u64 lo, hi;
+            memcpy(&lo, b, 8);
+            memcpy(&hi, b + 8, 8);
+            u128 x = ((u128)hi << 64) | lo;
+            if (x < P()) return to_mont(x);
+        }
+    }
+};
+
+// XofTurboShake128 message = len(dst) || dst || seed(16) || binder
+static void xof_message(std::vector<u8>& m, const u8* dst, size_t dlen,
+                        const u8* seed, const u8* binder, size_t blen) {
+    m.clear();
+    m.push_back((u8)dlen);
+    m.insert(m.end(), dst, dst + dlen);
+    m.insert(m.end(), seed, seed + 16);
+    if (blen) m.insert(m.end(), binder, binder + blen);
+}
+
+// Prio3 dst: version(8) | algo class(0) | algorithm id u32 BE | usage u16 BE
+static void make_dst(u8 out[8], uint32_t algo, uint16_t usage) {
+    out[0] = 8;
+    out[1] = 0;
+    out[2] = (u8)(algo >> 24);
+    out[3] = (u8)(algo >> 16);
+    out[4] = (u8)(algo >> 8);
+    out[5] = (u8)algo;
+    out[6] = (u8)(usage >> 8);
+    out[7] = (u8)usage;
+}
+
+static void expand_vec(std::vector<Fp>& out, size_t n, const u8* seed,
+                       uint16_t usage, const u8* binder, size_t blen) {
+    u8 dst[8];
+    make_dst(dst, 2 /* Prio3SumVec */, usage);
+    std::vector<u8> msg;
+    xof_message(msg, dst, 8, seed, binder, blen);
+    Turbo t;
+    t.init(msg.data(), msg.size());
+    out.resize(n);
+    for (size_t i = 0; i < n; i++) out[i] = t.next_fe();
+}
+
+static void derive_seed16(u8 out[16], const u8* seed, uint16_t usage,
+                          const u8* binder, size_t blen) {
+    u8 dst[8];
+    make_dst(dst, 2, usage);
+    std::vector<u8> msg;
+    xof_message(msg, dst, 8, seed, binder, blen);
+    Turbo t;
+    t.init(msg.data(), msg.size());
+    t.squeeze(out, 16);
+}
+
+// ---------------------------------------------------------------------------
+// Prio3SumVec helper prepare
+// ---------------------------------------------------------------------------
+
+static const uint16_t U_MEAS = 1, U_PROOF = 2, U_JR = 3, U_QUERY = 5,
+                      U_JR_SEED = 6, U_JR_PART = 7;
+
+extern "C" int p3sv_helper_prepare(
+    uint32_t length, uint32_t chunk, const u8* vk, const u8* nonce,
+    const u8* seed, const u8* blind, const u8* leader_part,
+    u8* out_prep_share /* 16 + VERIFIER_LEN*16 */, u8* out_jr_seed /*16*/) {
+    static bool inited = false;
+    if (!inited) {
+        field_init();
+        inited = true;
+    }
+    const uint32_t meas_len = length;           // bits = 1
+    const uint32_t calls = (meas_len + chunk - 1) / chunk;
+    uint32_t p2 = 1;
+    while (p2 < calls + 1) p2 <<= 1;
+    const uint32_t arity = 2 * chunk;
+    const uint32_t ncoeffs = 2 * (p2 - 1) + 1;  // degree-2 gadget
+    const uint32_t proof_len = arity + ncoeffs;
+
+    std::vector<Fp> meas, proof;
+    u8 agg_id = 0x01;
+    expand_vec(meas, meas_len, seed, U_MEAS, &agg_id, 1);
+    expand_vec(proof, proof_len, seed, U_PROOF, &agg_id, 1);
+
+    // joint randomness: own part over nonce || encoded meas share
+    std::vector<u8> jr_binder(1 + 16 + (size_t)meas_len * 16);
+    jr_binder[0] = 0x01;
+    memcpy(&jr_binder[1], nonce, 16);
+    for (uint32_t i = 0; i < meas_len; i++) {
+        u128 v = from_mont(meas[i]);
+        u64 lo = (u64)v, hi = (u64)(v >> 64);
+        memcpy(&jr_binder[17 + 16 * (size_t)i], &lo, 8);
+        memcpy(&jr_binder[17 + 16 * (size_t)i + 8], &hi, 8);
+    }
+    u8 own_part[16];
+    derive_seed16(own_part, blind, U_JR_PART, jr_binder.data(),
+                  jr_binder.size());
+    u8 parts[32];
+    memcpy(parts, leader_part, 16);
+    memcpy(parts + 16, own_part, 16);
+    u8 zero_seed[16] = {0};
+    derive_seed16(out_jr_seed, zero_seed, U_JR_SEED, parts, 32);
+    std::vector<Fp> joint_rand;
+    expand_vec(joint_rand, calls, out_jr_seed, U_JR, nullptr, 0);
+    std::vector<Fp> query_rand;
+    expand_vec(query_rand, 1, vk, U_QUERY, nonce, 16);
+
+    // FLP query: circuit eval with the gadget answered from the proof's
+    // gadget polynomial at alpha^(k+1); then wire polys at t.
+    Fp alpha = root_of_unity(p2);
+    std::vector<Fp> coeffs(proof.begin() + arity, proof.end());
+    std::vector<std::vector<Fp>> wire_evals(arity);
+    for (uint32_t w = 0; w < arity; w++) {
+        wire_evals[w].assign(p2, Fp{0});
+        wire_evals[w][0] = proof[w];  // wire seed at slot alpha^0
+    }
+    Fp v = Fp{0};
+    Fp point = alpha;
+    for (uint32_t k = 0; k < calls; k++) {
+        Fp r = joint_rand[k];
+        Fp w = r;
+        for (uint32_t j = 0; j < chunk; j++) {
+            uint32_t idx = k * chunk + j;
+            Fp elem = idx < meas_len ? meas[idx] : Fp{0};
+            wire_evals[2 * j][k + 1] = fmul(w, elem);
+            wire_evals[2 * j + 1][k + 1] = fsub(elem, SHARES_INV);
+            w = fmul(w, r);
+        }
+        v = fadd(v, poly_eval(coeffs, point));
+        point = fmul(point, alpha);
+    }
+    // note: the circuit's per-call gadget INPUTS come from consecutive
+    // chunks; wire w of call k is input index (k*chunk + j) as filled above
+
+    Fp t = query_rand[0];
+    if (from_mont(fpow(t, p2)) == 1) return -1;  // t in the eval domain
+
+    // verifier = [v] || wire polys at t || gadget poly at t
+    std::vector<Fp> verifier;
+    verifier.reserve(2 + arity);
+    verifier.push_back(v);
+    for (uint32_t w = 0; w < arity; w++) {
+        intt(wire_evals[w], alpha);
+        verifier.push_back(poly_eval(wire_evals[w], t));
+    }
+    verifier.push_back(poly_eval(coeffs, t));
+
+    memcpy(out_prep_share, own_part, 16);
+    for (size_t i = 0; i < verifier.size(); i++) {
+        u128 x = from_mont(verifier[i]);
+        u64 lo = (u64)x, hi = (u64)(x >> 64);
+        memcpy(out_prep_share + 16 + 16 * i, &lo, 8);
+        memcpy(out_prep_share + 16 + 16 * i + 8, &hi, 8);
+    }
+    return (int)verifier.size();
+}
+
+extern "C" double p3sv_helper_bench(uint32_t length, uint32_t chunk,
+                                    uint32_t iters) {
+    std::vector<u8> out(16 + 16 * (2 + 2 * (size_t)chunk + 64));
+    u8 jr[16], vk[16], nonce[16], seed[16], blind[16], part[16];
+    for (int i = 0; i < 16; i++) {
+        vk[i] = (u8)i;
+        nonce[i] = (u8)(i * 3);
+        seed[i] = (u8)(i * 5 + 1);
+        blind[i] = (u8)(i * 7 + 2);
+        part[i] = (u8)(i * 11 + 3);
+    }
+    auto t0 = std::chrono::steady_clock::now();
+    for (uint32_t it = 0; it < iters; it++) {
+        nonce[0] = (u8)it;
+        seed[1] = (u8)(it >> 8);
+        p3sv_helper_prepare(length, chunk, vk, nonce, seed, blind, part,
+                            out.data(), jr);
+    }
+    std::chrono::duration<double> dt =
+        std::chrono::steady_clock::now() - t0;
+    return iters / dt.count();
+}

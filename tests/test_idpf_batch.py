@@ -269,3 +269,54 @@ def test_party_byte_mismatch_matches_oracle():
             assert a.prep_share == b.prep_share
         else:
             assert a.error == b.error
+
+
+def test_adversarial_lanes_match_oracle():
+    """Count-check failures (combined ZC not in {0,1}) and non-canonical
+    leader prep-share elements (>= MODULUS) must produce bit-identical
+    outcomes on the batched fast path and the host oracle — these are the
+    kernel's zc_ok flag and the host-side in_range reroute."""
+    from janus_tpu.vdaf.idpf import Field255
+
+    vdaf = new_poplar1(4)
+    level, prefixes = 3, [0, 5, 9, 15]  # leaf (Field255)
+    ap = encode_agg_param(level, prefixes)
+    verify_key = bytes(range(16))
+    host = HostPrepEngine(vdaf).bind(ap)
+    dev = BatchPoplar1(vdaf, device_min_batch=1).bind(ap)
+    es = 32
+
+    nonces, pubs, shares1, inits = [], [], [], []
+    for i in range(6):
+        nonce = (i + 1).to_bytes(16, "big")
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard((i * 5) % 16, nonce, rand)
+        _st, msg = ping_pong.leader_initialized(
+            vdaf.with_agg_param(ap), verify_key, nonce, pub, ishares[0])
+        ps = bytearray(msg.prep_share)
+        if i in (1, 3):
+            # corrupt the leader's ZC share (3rd element): combined count
+            # lands outside {0, 1} -> "Poplar1 count check failed"
+            ps[2 * es] ^= 1
+        if i == 4:
+            # non-canonical element: exactly MODULUS (the oracle reduces
+            # it implicitly through modular adds; the kernel requires
+            # canonical inputs, so the lane must reroute)
+            ps[2 * es:3 * es] = Field255.MODULUS.to_bytes(es, "little")
+        msg = ping_pong.PingPongMessage(msg.type, prep_share=bytes(ps))
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares1.append(vdaf.encode_input_share(1, ishares[1]))
+        inits.append(msg)
+
+    res_d = dev.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    res_h = host.helper_init_batch(verify_key, nonces, pubs, shares1, inits)
+    statuses = [r.status for r in res_d]
+    assert statuses.count("failed") >= 2  # the corrupted-ZC lanes
+    for a, b in zip(res_d, res_h):
+        assert a.status == b.status
+        if a.status == "continued":
+            assert a.outbound.encode() == b.outbound.encode()
+            assert a.prep_share == b.prep_share
+        else:
+            assert a.error == b.error
