@@ -63,8 +63,23 @@ class AggregationJobDriver:
             return
         try:
             self.step_aggregation_job(lease)
-        except PeerHttpError:
-            # Release for retry; abandonment kicks in via lease_attempts.
+        except PeerHttpError as e:
+            # Retryable-vs-fatal split (reference
+            # aggregation_job_driver.rs:703-876): a deterministic peer
+            # rejection (4xx other than timeout/rate-limit) can never
+            # succeed on retry — surface it as FatalStepError so the
+            # generic driver abandons NOW instead of burning all lease
+            # attempts.  The lease is NOT released here on the fatal path:
+            # the abandoner's own transaction performs the release, and a
+            # pre-release would make that transaction's guarded release
+            # fail (and the job instantly re-acquirable by another
+            # replica mid-abandon).  Transport errors / 5xx / 408 / 429
+            # release for retry; abandonment then kicks in via
+            # lease_attempts.
+            if 400 <= e.status < 500 and e.status not in (408, 429):
+                from janus_tpu.aggregator.job_driver import FatalStepError
+
+                raise FatalStepError(str(e)) from e
             self._release(lease)
             raise
 
@@ -304,6 +319,11 @@ class AggregationJobDriver:
         self.datastore.run_tx("step_agg_job_write", txn)
 
     # -- abandonment (reference :703) --------------------------------------
+
+    def abandon(self, lease: m.Lease) -> None:
+        """Uniform abandonment entry point for the generic JobDriver's
+        FatalStepError handling."""
+        self.abandon_aggregation_job(lease)
 
     def abandon_aggregation_job(self, lease: m.Lease) -> None:
         """Terminal failure: the writer increments the batch shards'

@@ -166,6 +166,11 @@ class CollectionJobDriver:
 
         self.datastore.run_tx("coll_job_finish", finish)
 
+    def abandon(self, lease: m.Lease) -> None:
+        """Uniform abandonment entry point for the generic JobDriver's
+        FatalStepError handling."""
+        self.abandon_collection_job(lease)
+
     def abandon_collection_job(self, lease: m.Lease) -> None:
         def txn(tx):
             job = tx.get_collection_job(lease.leased.task_id,

@@ -6,14 +6,34 @@ Acquires leases through an `acquirer` callback, dispatches each to a
 worker's lease times out and any replica re-acquires it (SURVEY.md §5.3).
 `run_once()` exposes a single synchronous discovery round for tests and for
 cron-style deployments.
+
+Lease-safety discipline (reference job_driver.rs:225,253): every step is
+bounded by the EFFECTIVE lease duration (lease_duration - clock_skew).  A
+step still running at the deadline is timed out: the driver stops waiting,
+signals the per-round cancel event (steppers may poll it between network
+calls), counts `janus_job_step_timeouts`, and lets the lease expire for
+another replica — it will NOT hold a worker slot past the lease, which is
+exactly the double-stepping window the reference's future timeout closes.
+
+Error discipline (reference aggregation_job_driver.rs:703-876): a stepper
+that raises FatalStepError signals a DETERMINISTIC failure (e.g. the peer
+rejected the request outright); the driver invokes the `abandoner`
+immediately instead of letting the job silently burn all lease attempts
+on a failure that can never succeed.
 """
 
 from __future__ import annotations
 
 import threading
 import traceback
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass
+
+
+class FatalStepError(Exception):
+    """A non-retryable step failure: retrying can never succeed (the
+    reference's "fatal" arm of its error split).  The driver abandons the
+    job at once rather than after maximum_attempts_before_failure."""
 
 
 @dataclass
@@ -24,48 +44,115 @@ class JobDriverConfig:
     max_concurrent_job_workers: int = 10
     lease_duration_s: int = 600
     maximum_attempts_before_failure: int = 10
+    worker_clock_skew_s: int = 60  # reference's worker_lease_clock_skew
 
 
 class JobDriver:
-    def __init__(self, cfg: JobDriverConfig, acquirer, stepper):
-        """acquirer(limit) -> list[Lease]; stepper(lease) -> None."""
+    _tls = threading.local()  # per-step cancel token, see current_step_cancel
+
+    def __init__(self, cfg: JobDriverConfig, acquirer, stepper,
+                 abandoner=None):
+        """acquirer(limit) -> list[Lease]; stepper(lease) -> None;
+        abandoner(lease) -> None handles FatalStepError (optional)."""
         self.cfg = cfg
         self.acquirer = acquirer
         self.stepper = stepper
+        self.abandoner = abandoner
         self._stop = threading.Event()
+        # ONE persistent pool: a timed-out round must not leak a fresh
+        # executor's worth of hung threads every period — runaway steppers
+        # keep occupying their slots, shrinking the next round's
+        # acquisition budget until they finish (total threads and
+        # concurrent steps stay bounded by max_concurrent_job_workers).
+        self._pool = ThreadPoolExecutor(cfg.max_concurrent_job_workers)
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0
+
+    @classmethod
+    def current_step_cancel(cls) -> threading.Event | None:
+        """The cancel token of the step running on THIS thread (None
+        outside a step).  Steppers poll it between peer calls; tokens are
+        per-step, so a later round cannot revoke an earlier round's
+        signal."""
+        return getattr(cls._tls, "cancel", None)
+
+    @property
+    def effective_step_timeout_s(self) -> float:
+        return max(1.0,
+                   self.cfg.lease_duration_s - self.cfg.worker_clock_skew_s)
 
     def run_once(self) -> int:
-        """One discovery round: acquire up to the concurrency limit and step
-        every lease (synchronously, on the pool).  Returns #jobs stepped."""
+        """One discovery round: acquire up to the FREE worker slots and
+        step every lease on the pool, waiting AT MOST the effective lease
+        duration for the round.  Steps still running at the deadline are
+        timed out (counted, their cancel tokens set, leases left to
+        expire).  Returns #jobs stepped or timed out."""
         import time as _t
 
         from janus_tpu.metrics import job_acquire_time
 
+        with self._inflight_lock:
+            budget = self.cfg.max_concurrent_job_workers - self._inflight
+        if budget <= 0:
+            return 0
         t0 = _t.monotonic()
-        leases = self.acquirer(self.cfg.max_concurrent_job_workers)
+        leases = self.acquirer(budget)
         job_acquire_time.observe(_t.monotonic() - t0)
         if not leases:
             return 0
-        with ThreadPoolExecutor(self.cfg.max_concurrent_job_workers) as pool:
-            futures = [pool.submit(self._step, lease) for lease in leases]
-            for f in futures:
-                f.result()
+        deadline = _t.monotonic() + self.effective_step_timeout_s
+        pending = {}
+        for lease in leases:
+            cancel = threading.Event()
+            with self._inflight_lock:
+                self._inflight += 1
+            fut = self._pool.submit(self._step, lease, cancel)
+            fut.add_done_callback(self._step_done)
+            pending[fut] = cancel
+        outstanding = set(pending)
+        while outstanding:
+            remaining = deadline - _t.monotonic()
+            if remaining <= 0:
+                break
+            done, outstanding = wait(outstanding, timeout=remaining,
+                                     return_when=FIRST_COMPLETED)
+        if outstanding:
+            from janus_tpu.metrics import job_step_timeouts
+
+            job_step_timeouts.add(len(outstanding))
+            for fut in outstanding:
+                pending[fut].set()
         return len(leases)
 
-    def _step(self, lease) -> None:
+    def _step_done(self, _fut) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def _step(self, lease, cancel: threading.Event) -> None:
         import time as _t
 
         from janus_tpu.metrics import job_step_time
 
+        self._tls.cancel = cancel
         t0 = _t.monotonic()
         status = "success"
         try:
             self.stepper(lease)
+        except FatalStepError:
+            status = "fatal"
+            traceback.print_exc()
+            if self.abandoner is not None:
+                try:
+                    self.abandoner(lease)
+                except Exception:
+                    traceback.print_exc()
         except Exception:
-            # The lease simply expires; another replica will retry.
+            # Retryable: the lease expires (or was released with a delay);
+            # another replica retries, abandonment via lease_attempts.
             status = "error"
             traceback.print_exc()
         finally:
+            self._tls.cancel = None
             job_step_time.observe(_t.monotonic() - t0, status=status)
 
     def run(self) -> None:
@@ -81,3 +168,4 @@ class JobDriver:
 
     def stop(self) -> None:
         self._stop.set()
+        self._pool.shutdown(wait=False)

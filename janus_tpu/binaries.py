@@ -217,8 +217,11 @@ def _run_job_driver(make_driver, cfg: DriverBinaryConfig, ds: Datastore,
             lease_duration_s=cfg.job_driver.worker_lease_duration_s,
             maximum_attempts_before_failure=(
                 cfg.job_driver.maximum_attempts_before_failure),
+            worker_clock_skew_s=(
+                cfg.job_driver.worker_lease_clock_skew_allowance_s),
         ),
-        driver.acquirer, driver.stepper)
+        driver.acquirer, driver.stepper,
+        abandoner=getattr(driver, "abandon", None))
     t = threading.Thread(target=jd.run, daemon=True)
     t.start()
     stop.wait()

@@ -152,6 +152,9 @@ job_acquire_time = REGISTRY.histogram(
     "janus_job_acquire_time_seconds", "lease acquisition latency")
 job_step_time = REGISTRY.histogram(
     "janus_job_step_time_seconds", "job step latency")
+job_step_timeouts = REGISTRY.counter(
+    "janus_job_step_timeouts", "job steps timed out at the effective lease "
+    "duration (lease_duration - clock_skew); the lease expires for retry")
 tx_retry_counter = REGISTRY.counter(
     "janus_datastore_tx_retries", "datastore transaction retries")
 http_request_duration = REGISTRY.histogram(

@@ -285,3 +285,101 @@ def test_lease_expiry_mid_step_loses_write_race_cleanly():
         assert jobs[0].state is m.AggregationJobState.FINISHED
     finally:
         stop()
+
+
+def test_job_step_timeout_fires_before_lease_expiry():
+    """A hung stepper (slow mock peer) must not hold the discovery loop
+    past the effective lease duration: run_once returns at
+    lease_duration - clock_skew, counts janus_job_step_timeouts, and sets
+    the advisory cancel event (reference job_driver.rs:225,253)."""
+    import threading
+    import time
+
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.metrics import job_step_timeouts
+
+    release = threading.Event()
+    saw_cancel = threading.Event()
+
+    def hung_stepper(lease):
+        tok = JobDriver.current_step_cancel()
+        # "slow peer": poll the per-step cancel token between waits
+        for _ in range(300):
+            if tok is not None and tok.wait(0.1):
+                saw_cancel.set()
+                return
+        release.wait(30)
+
+    leases = [object()]
+    cfg = JobDriverConfig(lease_duration_s=3, worker_clock_skew_s=1)
+    jd = JobDriver(cfg, lambda limit: leases, hung_stepper)
+    assert jd.effective_step_timeout_s == 2
+    before = job_step_timeouts.value()
+    t0 = time.monotonic()
+    n = jd.run_once()
+    elapsed = time.monotonic() - t0
+    release.set()  # let the runaway thread finish
+    assert n == 1
+    assert elapsed < cfg.lease_duration_s, elapsed  # before lease expiry
+    assert elapsed >= jd.effective_step_timeout_s - 0.1
+    assert job_step_timeouts.value() == before + 1
+    assert saw_cancel.wait(5)  # the runaway step observed ITS token
+
+
+def test_fatal_step_error_abandons_immediately():
+    """FatalStepError (deterministic peer rejection) must invoke the
+    abandoner on the first attempt instead of burning all lease attempts
+    (reference aggregation_job_driver.rs:703-876)."""
+    from janus_tpu.aggregator.job_driver import (FatalStepError, JobDriver,
+                                                JobDriverConfig)
+
+    abandoned = []
+
+    def stepper(lease):
+        raise FatalStepError("helper returned 400: bad request")
+
+    calls = iter([[object()], []])
+    jd = JobDriver(JobDriverConfig(), lambda limit: next(calls), stepper,
+                   abandoner=abandoned.append)
+    assert jd.run_once() == 1
+    assert len(abandoned) == 1
+
+
+def test_peer_4xx_maps_to_fatal_and_5xx_stays_retryable():
+    """The aggregation job driver's error split: deterministic 4xx -> 
+    FatalStepError; 5xx/408/429 release for lease-based retry."""
+    import pytest as _pytest
+
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.http_client import PeerHttpError
+    from janus_tpu.aggregator.job_driver import FatalStepError
+
+    class _Lease:
+        lease_attempts = 1
+        leased = None
+
+    drv = AggregationJobDriver.__new__(AggregationJobDriver)
+    drv.max_attempts = 10
+    released = []
+    drv._release = lambda lease: released.append(lease)
+
+    def boom(status):
+        def f(lease):
+            raise PeerHttpError(status, b"nope")
+
+        return f
+
+    for status, want_fatal in [(400, True), (403, True), (404, True),
+                               (408, False), (429, False), (500, False),
+                               (503, False)]:
+        drv.step_aggregation_job = boom(status)
+        if want_fatal:
+            with _pytest.raises(FatalStepError):
+                drv.stepper(_Lease())
+        else:
+            with _pytest.raises(PeerHttpError):
+                drv.stepper(_Lease())
+    # retryable paths release for lease-based retry; fatal paths leave the
+    # lease to the abandoner's own transaction (a pre-release would roll
+    # that transaction back)
+    assert len(released) == 4
