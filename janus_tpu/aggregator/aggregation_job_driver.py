@@ -76,7 +76,10 @@ class AggregationJobDriver:
             # replica mid-abandon).  Transport errors / 5xx / 408 / 429
             # release for retry; abandonment then kicks in via
             # lease_attempts.
-            if 400 <= e.status < 500 and e.status not in (408, 429):
+            from janus_tpu.core.retries import is_retryable_http_status
+
+            if 400 <= e.status < 500 and not is_retryable_http_status(
+                    e.status):
                 from janus_tpu.aggregator.job_driver import FatalStepError
 
                 raise FatalStepError(str(e)) from e

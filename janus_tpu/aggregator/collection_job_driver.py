@@ -56,7 +56,18 @@ class CollectionJobDriver:
             return
         try:
             self.step_collection_job(lease)
-        except PeerHttpError:
+        except PeerHttpError as e:
+            # Same fatal/retryable split as the aggregation driver: a
+            # deterministic helper rejection abandons now (the abandoner's
+            # own transaction releases the lease); transient failures
+            # release with the retry delay and burn a lease attempt.
+            from janus_tpu.core.retries import is_retryable_http_status
+
+            if 400 <= e.status < 500 and not is_retryable_http_status(
+                    e.status):
+                from janus_tpu.aggregator.job_driver import FatalStepError
+
+                raise FatalStepError(str(e)) from e
             self._release(lease, self.retry_delay)
             raise
 

@@ -104,9 +104,12 @@ class JobDriver:
         pending = {}
         for lease in leases:
             cancel = threading.Event()
+            try:
+                fut = self._pool.submit(self._step, lease, cancel)
+            except RuntimeError:
+                break  # pool shut down mid-round (stop()); lease expires
             with self._inflight_lock:
                 self._inflight += 1
-            fut = self._pool.submit(self._step, lease, cancel)
             fut.add_done_callback(self._step_done)
             pending[fut] = cancel
         outstanding = set(pending)
