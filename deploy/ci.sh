@@ -21,6 +21,18 @@ if [ -n "${JANUS_TPU_TEST_PG_DSN:-}" ]; then
       -q -k "pg or postgres or not sqlite_only"
 fi
 
+if [ -n "${JANUS_TPU_TEST_PG_DSN:-}" ] && [ -n "${JANUS_TPU_TEST_PG_DSN_HELPER:-}" ]; then
+  # The composed five-service end-to-end ON PostgreSQL: the deployed
+  # topology's substrate (deploy/docker-compose.yaml provisions one PG per
+  # aggregator; here the two DSNs stand in for those services).  The pass
+  # line is the committed artifact shape: "compose_e2e OK: ... backend=postgres".
+  echo "== composed-services end-to-end (PostgreSQL) =="
+  python deploy/compose_e2e.py \
+      --leader-db "$JANUS_TPU_TEST_PG_DSN" \
+      --helper-db "$JANUS_TPU_TEST_PG_DSN_HELPER" \
+      | tee deploy/PG_E2E_last_run.log
+fi
+
 echo "== interop conformance selftest =="
 python -m janus_tpu.interop
 

@@ -11,9 +11,9 @@ commands, provisions a Prio3Count task in both aggregators, uploads reports
 through the client SDK, and polls a collection to completion.  Exit 0 iff
 the collected aggregate equals the expected sum.
 
-With --external it skips spawning and drives an already-running pair (e.g.
-the docker-compose stack) — then task provisioning must have been done with
-matching parameters inside the containers.
+Process-based: it spawns the five services itself (the same commands the
+containers run) and drives them over HTTP; for the docker topology,
+provision tasks via `docker compose exec` + tools, then drive the ports.
 
 Usage:
     python deploy/compose_e2e.py            # self-contained process pair
@@ -70,6 +70,12 @@ def wait_health(port: int, timeout: float = 60.0) -> None:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--leader-db", default=None,
+                    help="datastore URL for the leader (a postgresql:// DSN "
+                         "runs the whole e2e on the PostgreSQL backend; "
+                         "default: a temp sqlite file)")
+    ap.add_argument("--helper-db", default=None,
+                    help="datastore URL for the helper (see --leader-db)")
     args = ap.parse_args()
 
     from janus_tpu.core.auth_tokens import AuthenticationToken
@@ -82,8 +88,8 @@ def main() -> int:
     col_token = AuthenticationToken("Bearer", b64(secrets.token_bytes(16)))
     collector_kp = HpkeKeypair.generate(7)
 
-    leader_db = os.path.join(tmp, "leader.db")
-    helper_db = os.path.join(tmp, "helper.db")
+    leader_db = args.leader_db or os.path.join(tmp, "leader.db")
+    helper_db = args.helper_db or os.path.join(tmp, "helper.db")
     leader_port, helper_port = free_port(), free_port()
     health = [free_port() for _ in range(5)]
     keys = {leader_db: b64(secrets.token_bytes(16)),
@@ -97,7 +103,12 @@ def main() -> int:
 
     # -- provision both sides (reference janus_cli provision-tasks) -------
     for db in (leader_db, helper_db):
-        tools("write-schema", "--db", db, db=db)
+        if db.startswith(("postgres://", "postgresql://")):
+            # persistent server: reset so reruns are repeatable (fresh
+            # datastore keys cannot decrypt a previous run's rows)
+            tools("write-schema", "--db", db, "--drop", db=db)
+        else:
+            tools("write-schema", "--db", db, db=db)
     common = f"""  query_type: TimeInterval
   vdaf: Prio3Count
   vdaf_verify_key: {b64(verify_key)}
@@ -222,8 +233,10 @@ def main() -> int:
         assert result is not None, "collection never completed"
         assert result.report_count == len(MEASUREMENTS), result
         assert result.aggregate_result == sum(MEASUREMENTS), result
+        backend = ("postgres" if str(leader_db).startswith(
+            ("postgres://", "postgresql://")) else "sqlite")
         print(f"compose_e2e OK: {result.report_count} reports, "
-              f"aggregate={result.aggregate_result}")
+              f"aggregate={result.aggregate_result}, backend={backend}")
         return 0
     finally:
         for p in procs:

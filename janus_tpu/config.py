@@ -106,11 +106,42 @@ def _build(cls, obj):
     return cls(**kwargs)
 
 
+_ENV_REF = __import__("re").compile(
+    r"\$\{([A-Za-z_][A-Za-z0-9_]*)(?::-([^}]*))?\}")
+
+
+def _expand_env(obj):
+    """Expand ${VAR} / ${VAR:-default} in every string value — the compose
+    topology parameterizes the database DSN's password this way, with the
+    SAME semantics as docker compose's :- operator: unset OR EMPTY falls
+    back to the default.  A bare ${VAR} that is unset raises (a typo'd
+    variable must not silently become an empty string inside a DSN)."""
+    import os
+
+    def sub(m):
+        name, default = m.group(1), m.group(2)
+        val = os.environ.get(name)
+        if default is not None:
+            return val if val else default  # unset-or-empty -> default
+        if val is None:
+            raise ValueError(
+                f"config references ${{{name}}} but it is not set")
+        return val
+
+    if isinstance(obj, str):
+        return _ENV_REF.sub(sub, obj)
+    if isinstance(obj, dict):
+        return {k: _expand_env(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_expand_env(v) for v in obj]
+    return obj
+
+
 def load_config(cls, path: str):
     with open(path) as f:
         obj = yaml.safe_load(f) or {}
-    return _build(cls, obj)
+    return _build(cls, _expand_env(obj))
 
 
 def loads_config(cls, text: str):
-    return _build(cls, yaml.safe_load(text) or {})
+    return _build(cls, _expand_env(yaml.safe_load(text) or {}))

@@ -55,6 +55,18 @@ from janus_tpu.messages import (
 from janus_tpu.models import VdafInstance
 
 
+def backend_for_url(url: str):
+    """URL-scheme backend dispatch, shared by the service binaries and the
+    CLI tools: postgresql:// DSNs open the PostgreSQL backend, anything
+    else is a sqlite path (":memory:"/"" for in-memory)."""
+    if url.startswith(("postgres://", "postgresql://")):
+        from janus_tpu.datastore.postgres import PostgresBackend
+
+        return PostgresBackend(url)
+    path = None if url in (":memory:", "") else url.removeprefix("sqlite://")
+    return SqliteBackend(path)
+
+
 class DatastoreError(Exception):
     pass
 
@@ -166,6 +178,20 @@ class Datastore:
         if getattr(self.backend, "dialect", "sqlite") == "postgres":
             return self.backend.connect(ddl=True)
         return self.backend.connect()
+
+    def drop_schema(self) -> None:
+        """Drop every janus table (IF EXISTS — portable across sqlite and
+        PostgreSQL).  For repeatable e2e runs against a persistent
+        database (tools write-schema --drop); DESTRUCTIVE."""
+        from janus_tpu.datastore.schema import TABLE_NAMES
+
+        conn = self._connect_ddl()
+        try:
+            with conn:
+                for name in reversed(TABLE_NAMES):
+                    conn.execute(f"DROP TABLE IF EXISTS {name}")
+        finally:
+            conn.close()
 
     def put_schema(self) -> None:
         conn = self._connect_ddl()

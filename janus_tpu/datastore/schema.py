@@ -249,3 +249,13 @@ TABLES = [
     CREATE TABLE schema_version (version INTEGER NOT NULL)
     """,
 ]
+
+
+# Every table put_schema creates, in creation order (drop in reverse).
+TABLE_NAMES = [
+    "global_hpke_keys", "taskprov_peer_aggregators", "tasks",
+    "task_hpke_keys", "task_upload_counters", "client_reports",
+    "aggregation_jobs", "report_aggregations", "batch_aggregations",
+    "collection_jobs", "aggregate_share_jobs", "outstanding_batches",
+    "batch_queries", "schema_version",
+]

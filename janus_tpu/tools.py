@@ -31,14 +31,18 @@ def _open_datastore(db: str, keys: list[str]):
     from janus_tpu.core.time import RealClock
     from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
 
+    from janus_tpu.datastore.datastore import backend_for_url
+
     crypter = Crypter([_unb64(k) for k in keys])
-    return Datastore(SqliteBackend(db), crypter, RealClock())
+    return Datastore(backend_for_url(db), crypter, RealClock())
 
 
 def cmd_write_schema(args) -> int:
     from janus_tpu.datastore.schema import SCHEMA_VERSION
 
     ds = _open_datastore(args.db, [_b64(b"\0" * 16)])
+    if getattr(args, "drop", False):
+        ds.drop_schema()
     ds.put_schema()
     print(f"schema v{SCHEMA_VERSION} written to {args.db}")
     return 0
@@ -218,6 +222,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("write-schema")
     p.add_argument("--db", required=True)
+    p.add_argument("--drop", action="store_true",
+                   help="drop existing janus tables first (DESTRUCTIVE; "
+                        "for repeatable e2e runs on a persistent database)")
     p.set_defaults(fn=cmd_write_schema)
 
     p = sub.add_parser("create-datastore-key")
