@@ -45,14 +45,9 @@ def build_datastore(common, datastore_keys: list[str] | None) -> Datastore:
         raise SystemExit("no datastore keys provided "
                          "(--datastore-keys or JANUS_DATASTORE_KEYS)")
     keys = [base64.urlsafe_b64decode(k + "=" * (-len(k) % 4)) for k in keys_b64]
-    url = common.database.url
-    if url.startswith(("postgres://", "postgresql://")):
-        from janus_tpu.datastore.postgres import PostgresBackend
+    from janus_tpu.datastore.datastore import backend_for_url
 
-        backend = PostgresBackend(url)
-    else:
-        path = None if url in (":memory:", "") else url.removeprefix("sqlite://")
-        backend = SqliteBackend(path)
+    backend = backend_for_url(common.database.url)
     ds = Datastore(backend, Crypter(keys), RealClock(),
                    max_transaction_retries=common.max_transaction_retries)
     try:
