@@ -49,13 +49,33 @@ def _host_arch_tag() -> str:
 
     tag = platform.machine()
     try:
+        flags = model = ""
+        arm_id: list[str] = []
         with open("/proc/cpuinfo") as f:
             for line in f:
-                if line.startswith(("flags", "Features")):
-                    feats = hashlib.sha256(
-                        " ".join(sorted(line.split(":", 1)[1].split()))
-                        .encode()).hexdigest()[:8]
-                    return f"{tag}-{feats}"
+                if not flags and line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                elif not model and line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                elif line.startswith(("CPU implementer", "CPU part",
+                                      "CPU variant")) and len(arm_id) < 3:
+                    # aarch64 has no "model name": the implementer/part/
+                    # variant triple is the microarchitecture identity
+                    arm_id.append(line.split(":", 1)[1].strip())
+                if flags and model:
+                    break
+        if not model and arm_id:
+            model = " ".join(arm_id)
+        if flags or model:
+            # The MODEL matters, not just the flag set: LLVM derives
+            # per-model TUNING features (prefer-no-gather etc.) that two
+            # hosts with identical cpuinfo flags can disagree on — and a
+            # mismatched AOT entry can SIGSEGV on deserialize, not just
+            # warn (observed: suite crash in compilation_cache loading a
+            # foreign-host entry).
+            feats = hashlib.sha256(
+                f"{model}|{flags}".encode()).hexdigest()[:8]
+            return f"{tag}-{feats}"
     except OSError:
         pass
     return tag
