@@ -107,26 +107,28 @@ def enable_compilation_cache(path: str | None = None) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-    # Serialize + exception-guard cache WRITES.  Two threads compiling at
-    # once (the coalescer's worker groups) can both enter the persistent
-    # cache's write path; against a cold cache directory this aborted the
-    # process (SIGABRT inside put_executable_and_time).  A cache write is
-    # an optimization, never worth the process: one at a time, and any
-    # failure degrades to "not cached".
+    # Serialize cache WRITES.  Two threads compiling at once (the
+    # coalescer's worker groups) can both enter the persistent cache's
+    # write path; against a cold cache directory this aborted the process
+    # (SIGABRT — a native abort, so only the lock can prevent it; Python
+    # exceptions stay with JAX's own caller-side guard, which warns and
+    # honors jax_raise_persistent_cache_errors).  The private-API access
+    # is best-effort: if a JAX upgrade moves the symbol, we skip the
+    # guard rather than fail every entrypoint over an optimization.
     import threading as _threading
 
-    from jax._src import compilation_cache as _cc
+    try:
+        from jax._src import compilation_cache as _cc
 
-    if not getattr(_cc, "_janus_write_guard", False):
         _orig_put = _cc.put_executable_and_time
+    except (ImportError, AttributeError):
+        return
+    if not getattr(_cc, "_janus_write_guard", False):
         _put_lock = _threading.Lock()
 
         def _guarded_put(*args, **kwargs):
             with _put_lock:
-                try:
-                    return _orig_put(*args, **kwargs)
-                except Exception:
-                    return None
+                return _orig_put(*args, **kwargs)
 
         _cc.put_executable_and_time = _guarded_put
         _cc._janus_write_guard = True
