@@ -94,6 +94,20 @@ def enable_compilation_cache(path: str | None = None) -> None:
 
     import jax
 
+    # The XLA:CPU AOT reload path is UNSAFE on some hosts in this
+    # environment: entries this very host wrote can SIGSEGV on
+    # deserialize (the loader's feature-fixup path; reproduced three
+    # times at different suite points, including self-written entries in
+    # a fresh directory).  The persistent cache therefore stays OFF for
+    # the CPU backend — in-process jit caching still dedups within a run
+    # — and ON for the TPU path, whose (remote-compile) cache has been
+    # reliable.  JANUS_TPU_FORCE_CPU_CACHE=1 re-enables for debugging.
+    platform = (os.environ.get("JAX_PLATFORMS")
+                or getattr(jax.config, "jax_platforms", None) or "")
+    if ("cpu" in str(platform)
+            and not int(os.environ.get("JANUS_TPU_FORCE_CPU_CACHE", "0"))):
+        return
+
     cache_dir = path
     if cache_dir is None:
         # the arch tag applies to the env-var path too: that is exactly how
