@@ -81,54 +81,17 @@ def _host_arch_tag() -> str:
     return tag
 
 
-def enable_compilation_cache(path: str | None = None) -> None:
-    """Enable JAX's persistent compilation cache for the VDAF kernels.
-
-    The batch-prepare executables are large (wide field-limb arithmetic);
-    caching them makes every process after the first start in milliseconds.
-    Called by the test suite, bench.py, and the aggregator binaries.  The
-    default directory is keyed by host microarchitecture (_host_arch_tag)
-    so entries compiled on one machine never mis-load on another.
-    """
-    import os
-
-    import jax
-
-    # The XLA:CPU AOT reload path is UNSAFE on some hosts in this
-    # environment: entries this very host wrote can SIGSEGV on
-    # deserialize (the loader's feature-fixup path; reproduced three
-    # times at different suite points, including self-written entries in
-    # a fresh directory).  The persistent cache therefore stays OFF for
-    # the CPU backend — in-process jit caching still dedups within a run
-    # — and ON for the TPU path, whose (remote-compile) cache has been
-    # reliable.  JANUS_TPU_FORCE_CPU_CACHE=1 re-enables for debugging.
-    platform = (os.environ.get("JAX_PLATFORMS")
-                or getattr(jax.config, "jax_platforms", None) or "")
-    if ("cpu" in str(platform)
-            and not int(os.environ.get("JANUS_TPU_FORCE_CPU_CACHE", "0"))):
-        return
-
-    cache_dir = path
-    if cache_dir is None:
-        # the arch tag applies to the env-var path too: that is exactly how
-        # shared cache volumes are configured (deploy/Dockerfile), and a
-        # shared volume across heterogeneous hosts is the mis-load scenario
-        base = os.environ.get(
-            "JANUS_TPU_COMPILATION_CACHE",
-            os.path.expanduser("~/.cache/janus_tpu_xla"))
-        cache_dir = os.path.join(base, _host_arch_tag())
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-
-    # Serialize cache WRITES.  Two threads compiling at once (the
-    # coalescer's worker groups) can both enter the persistent cache's
-    # write path; against a cold cache directory this aborted the process
-    # (SIGABRT — a native abort, so only the lock can prevent it; Python
-    # exceptions stay with JAX's own caller-side guard, which warns and
-    # honors jax_raise_persistent_cache_errors).  The private-API access
-    # is best-effort: if a JAX upgrade moves the symbol, we skip the
-    # guard rather than fail every entrypoint over an optimization.
+def _install_cache_write_lock() -> None:
+    """Serialize persistent-cache WRITES.  Two threads compiling at once
+    (the coalescer's worker groups) can both enter the cache's write path;
+    against a cold cache directory this aborted the process (SIGABRT — a
+    native abort, so only a lock can prevent it; Python exceptions stay
+    with JAX's own caller-side guard, which warns and honors
+    jax_raise_persistent_cache_errors).  Installed unconditionally, even
+    when this module declines to configure a cache dir — operators can
+    enable the cache through JAX's native env knobs.  The private-API
+    access is best-effort: if a JAX upgrade moves the symbol, we skip the
+    guard rather than fail every entrypoint over an optimization."""
     import threading as _threading
 
     try:
@@ -146,3 +109,56 @@ def enable_compilation_cache(path: str | None = None) -> None:
 
         _cc.put_executable_and_time = _guarded_put
         _cc._janus_write_guard = True
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Enable JAX's persistent compilation cache for the VDAF kernels.
+
+    The batch-prepare executables are large (wide field-limb arithmetic);
+    caching them makes every process after the first start in milliseconds.
+    Called by the test suite, bench.py, and the aggregator binaries.  The
+    default directory is keyed by host microarchitecture (_host_arch_tag)
+    so entries compiled on one machine never mis-load on another.
+    """
+    import os
+
+    import jax
+
+    _install_cache_write_lock()
+
+    # The XLA:CPU AOT reload path is UNSAFE on some hosts in this
+    # environment: entries this very host wrote can SIGSEGV on
+    # deserialize (the loader's feature-fixup path; reproduced three
+    # times at different suite points, including self-written entries in
+    # a fresh directory).  The persistent cache therefore stays OFF for
+    # the CPU backend — in-process jit caching still dedups within a run
+    # — and ON for the TPU path, whose (remote-compile) cache has been
+    # reliable.  JANUS_TPU_FORCE_CPU_CACHE=1 re-enables for debugging.
+    platform = (os.environ.get("JAX_PLATFORMS")
+                or getattr(jax.config, "jax_platforms", None) or "")
+    primary = str(platform).split(",")[0].strip().lower()
+    if not primary:
+        try:  # nothing pinned a platform: ask for the auto-selected one
+            primary = jax.default_backend()
+        except Exception:
+            primary = ""
+    force = os.environ.get(
+        "JANUS_TPU_FORCE_CPU_CACHE", "").strip().lower() in (
+        "1", "true", "yes", "on")
+    if primary == "cpu" and not force:
+        return
+
+    cache_dir = path
+    if cache_dir is None:
+        # the arch tag applies to the env-var path too: that is exactly how
+        # shared cache volumes are configured (deploy/Dockerfile), and a
+        # shared volume across heterogeneous hosts is the mis-load scenario
+        base = os.environ.get(
+            "JANUS_TPU_COMPILATION_CACHE",
+            os.path.expanduser("~/.cache/janus_tpu_xla"))
+        cache_dir = os.path.join(base, _host_arch_tag())
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
