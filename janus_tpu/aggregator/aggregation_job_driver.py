@@ -11,6 +11,7 @@ lease attempts (reference :703)."""
 
 from __future__ import annotations
 
+from janus_tpu import flight_recorder
 from janus_tpu.aggregator.aggregation_job_writer import (
     AggregationJobWriter,
     WritableReportAggregation,
@@ -58,12 +59,21 @@ class AggregationJobDriver:
                 self.lease_duration, limit))
 
     def stepper(self, lease: m.Lease) -> None:
+        acquired = lease.leased
+        flight_recorder.record(
+            "acquired", task_id=getattr(acquired, "task_id", None),
+            job_id=getattr(acquired, "aggregation_job_id", None),
+            kind="aggregation", attempts=lease.lease_attempts)
         if lease.lease_attempts > self.max_attempts:
             self.abandon_aggregation_job(lease)
             return
         try:
             self.step_aggregation_job(lease)
         except PeerHttpError as e:
+            flight_recorder.record(
+                "step_failed", task_id=getattr(acquired, "task_id", None),
+                job_id=getattr(acquired, "aggregation_job_id", None),
+                kind="aggregation", failure="peer_http_error", status=e.status)
             # Retryable-vs-fatal split (reference
             # aggregation_job_driver.rs:703-876): a deterministic peer
             # rejection (4xx other than timeout/rate-limit) can never
@@ -145,6 +155,9 @@ class AggregationJobDriver:
                         reports=len(nonces)):
             prepared = engine.leader_init_batch(task.vdaf_verify_key, nonces,
                                                 pubs, shares)
+        flight_recorder.record(
+            "device_batch", task_id=task.task_id, job_id=job.id,
+            kind="leader_init", reports=len(nonces))
 
         prepare_inits = []
         continued = []  # (ra, PreparedReport)
@@ -320,6 +333,10 @@ class AggregationJobDriver:
             tx.release_aggregation_job(lease)
 
         self.datastore.run_tx("step_agg_job_write", txn)
+        flight_recorder.record(
+            "stepped", task_id=task.task_id, job_id=job.id,
+            kind="aggregation", step=job.step.value, state=job.state.name,
+            reports=len(writables))
 
     # -- abandonment (reference :703) --------------------------------------
 
@@ -350,6 +367,10 @@ class AggregationJobDriver:
             tx.release_aggregation_job(lease)
 
         self.datastore.run_tx("abandon_agg_job", txn)
+        flight_recorder.record(
+            "abandoned", task_id=acquired.task_id,
+            job_id=acquired.aggregation_job_id, kind="aggregation",
+            attempts=lease.lease_attempts)
 
     def _release(self, lease: m.Lease) -> None:
         def txn(tx):

@@ -18,6 +18,7 @@ import time as _time
 import numpy as np
 from dataclasses import dataclass, field
 
+from janus_tpu import flight_recorder
 from janus_tpu.aggregator import error as err
 from janus_tpu.aggregator.aggregation_job_writer import (
     AggregationJobWriter,
@@ -772,6 +773,9 @@ class Aggregator:
         _mark("resp_encode")
         # phase-time observability: consumed by bench.py and /debug/state
         self.last_init_timings = t_phase
+        flight_recorder.record(
+            "helper_init", task_id=task_id, job_id=job_id, kind="aggregation",
+            reports=len(req.prepare_inits))
         return out
 
     def _handle_init_columnar(self, ta: TaskAggregator, task_id: TaskId,
@@ -1334,6 +1338,9 @@ class Aggregator:
         resp = self.datastore.run_tx("aggregate_init", txn)
         _mark("tx")
         self.last_init_timings = t_phase
+        flight_recorder.record(
+            "helper_init", task_id=task_id, job_id=job_id, kind="aggregation",
+            reports=n, columnar=True)
         return resp
 
     @staticmethod

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from dataclasses import replace
 
+from janus_tpu import flight_recorder
 from janus_tpu.aggregator.aggregator import merge_batch_aggregations
 from janus_tpu.aggregator.http_client import PeerClient, PeerHttpError
 from janus_tpu.aggregator.query_type import logic_for
@@ -51,12 +52,21 @@ class CollectionJobDriver:
                 self.lease_duration, limit))
 
     def stepper(self, lease: m.Lease) -> None:
+        acquired = lease.leased
+        flight_recorder.record(
+            "acquired", task_id=getattr(acquired, "task_id", None),
+            job_id=getattr(acquired, "collection_job_id", None),
+            kind="collection", attempts=lease.lease_attempts)
         if lease.lease_attempts > self.max_attempts:
             self.abandon_collection_job(lease)
             return
         try:
             self.step_collection_job(lease)
         except PeerHttpError as e:
+            flight_recorder.record(
+                "step_failed", task_id=getattr(acquired, "task_id", None),
+                job_id=getattr(acquired, "collection_job_id", None),
+                kind="collection", failure="peer_http_error", status=e.status)
             # Same fatal/retryable split as the aggregation driver: a
             # deterministic helper rejection abandons now (the abandoner's
             # own transaction releases the lease); transient failures
@@ -131,6 +141,8 @@ class CollectionJobDriver:
 
         shards = self.datastore.run_tx("coll_job_gate", gate)
         if shards is None:
+            flight_recorder.record(
+                "unready", task_id=task_id, job_id=job_id, kind="collection")
             self._release(lease, self.retry_delay)
             return
 
@@ -176,6 +188,9 @@ class CollectionJobDriver:
             tx.release_collection_job(lease)
 
         self.datastore.run_tx("coll_job_finish", finish)
+        flight_recorder.record(
+            "stepped", task_id=task_id, job_id=job_id, kind="collection",
+            state="finished", reports=count)
 
     def abandon(self, lease: m.Lease) -> None:
         """Uniform abandonment entry point for the generic JobDriver's
@@ -192,6 +207,10 @@ class CollectionJobDriver:
             tx.release_collection_job(lease)
 
         self.datastore.run_tx("abandon_coll_job", txn)
+        flight_recorder.record(
+            "abandoned", task_id=lease.leased.task_id,
+            job_id=lease.leased.collection_job_id, kind="collection",
+            attempts=lease.lease_attempts)
 
     def _release(self, lease: m.Lease, delay: Duration | None) -> None:
         def txn(tx):

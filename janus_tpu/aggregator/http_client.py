@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+from janus_tpu import trace
 from janus_tpu.core.retries import Backoff, HttpResult, retry_http_request
 from janus_tpu.datastore.task import AggregatorTask
 
@@ -50,7 +51,14 @@ class PeerClient:
                 raise OSError(str(e)) from e
             return HttpResult(resp.status_code, dict(resp.headers), resp.content)
 
-        result = retry_http_request(attempt, self.backoff)
+        # Client span around the full retry loop; its context rides the
+        # request as a W3C traceparent so the helper's handler span joins
+        # this trace rather than starting its own.
+        with trace.span("helper request", method=method, path=path):
+            ctx = trace.current_context()
+            if ctx is not None and trace.propagation_enabled():
+                headers["traceparent"] = trace.format_traceparent(ctx)
+            result = retry_http_request(attempt, self.backoff)
         if not 200 <= result.status < 300:
             raise PeerHttpError(result.status, result.body)
         return result

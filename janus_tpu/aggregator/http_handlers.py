@@ -25,6 +25,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from janus_tpu import trace
 from janus_tpu.aggregator import error as err
 from janus_tpu.aggregator.aggregator import Aggregator
 from janus_tpu.core.auth_tokens import AuthenticationToken
@@ -95,6 +96,8 @@ class DapRouter:
 
         t0 = _t.monotonic()
         route = "unmatched"  # bounded label even on error paths
+        remote_ctx = (trace.parse_traceparent(self._traceparent(headers))
+                      if trace.propagation_enabled() else None)
         try:
             for m_, rx, name in _ROUTES:
                 if m_ != method:
@@ -102,7 +105,12 @@ class DapRouter:
                 match = rx.match(path)
                 if match:
                     route = name
-                    resp = getattr(self, "_" + name)(match, query, body, headers)
+                    # resume the caller's trace (Leader -> Helper) so the
+                    # whole aggregation round trip is one correlated trace
+                    with trace.span(f"DAP {name}", parent=remote_ctx,
+                                    method=method):
+                        resp = getattr(self, "_" + name)(match, query, body,
+                                                         headers)
                     http_request_duration.observe(
                         _t.monotonic() - t0, route=route, status=resp.status)
                     return resp
@@ -129,6 +137,15 @@ class DapRouter:
             return _Response(500, json.dumps({
                 "status": 500, "detail": "internal error"}).encode(),
                 PROBLEM_JSON, headers=cors)
+
+    @staticmethod
+    def _traceparent(headers) -> str | None:
+        # headers may be an http.client.HTTPMessage (case-insensitive) or a
+        # plain dict from tests/in-process callers
+        value = headers.get("traceparent")
+        if value is None and isinstance(headers, dict):
+            value = headers.get("Traceparent")
+        return value
 
     # -- route handlers ----------------------------------------------------
 

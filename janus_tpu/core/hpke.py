@@ -18,16 +18,26 @@ import hmac as hmac_mod
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import (
-    AESGCM,
-    ChaCha20Poly1305,
-)
-from cryptography.hazmat.primitives import serialization
+try:
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        AESGCM,
+        ChaCha20Poly1305,
+    )
+    from cryptography.hazmat.primitives import serialization
+except ModuleNotFoundError:  # optional dep: fall back to pure Python
+    from janus_tpu.core.softcrypto import (
+        AESGCM,
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        ec,
+        serialization,
+    )
 
 from janus_tpu.messages import (
     HpkeAeadId,

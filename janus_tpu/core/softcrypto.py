@@ -1,0 +1,577 @@
+"""Pure-Python fallback crypto primitives for hosts without the optional
+`cryptography` package.
+
+Drop-in replacements for the narrow slice of the `cryptography` API that
+janus_tpu uses (core/hpke.py, datastore/datastore.py): AES-GCM,
+ChaCha20-Poly1305, X25519, and P-256 ECDH.  Interfaces mirror
+`cryptography.hazmat.primitives` so call sites gate the import and change
+nothing else:
+
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ModuleNotFoundError:
+        from janus_tpu.core.softcrypto import AESGCM
+
+Python-int arithmetic throughout — orders of magnitude slower than the
+native backend, but DAP payloads are small (reports are hundreds of bytes)
+and the hot batched-open path runs on the device kernels (ops/gcm.py), so
+host AEAD speed is not on the serving critical path.  Correctness is
+pinned by the HPKE/GCM known-answer tests in the test suite.
+
+Not constant-time: acceptable for a fallback aimed at dev boxes and CI
+containers; production deployments install `cryptography`.
+"""
+
+from __future__ import annotations
+
+import hmac as _hmac
+import os as _os
+
+__all__ = [
+    "AESGCM",
+    "ChaCha20Poly1305",
+    "Cipher",
+    "InvalidTag",
+    "X25519PrivateKey",
+    "X25519PublicKey",
+    "algorithms",
+    "ec",
+    "modes",
+    "serialization",
+]
+
+
+class InvalidTag(Exception):
+    """AEAD authentication failure (mirrors cryptography.exceptions)."""
+
+
+# ---------------------------------------------------------------------------
+# AES block cipher (encrypt direction only — CTR and GCM need no inverse)
+# ---------------------------------------------------------------------------
+
+_SBOX = bytes.fromhex(
+    "637c777bf26b6fc53001672bfed7ab76ca82c97dfa5947f0add4a2af9ca472c0"
+    "b7fd9326363ff7cc34a5e5f171d8311504c723c31896059a071280e2eb27b275"
+    "09832c1a1b6e5aa0523bd6b329e32f8453d100ed20fcb15b6acbbe394a4c58cf"
+    "d0efaafb434d338545f9027f503c9fa851a3408f929d38f5bcb6da2110fff3d2"
+    "cd0c13ec5f974417c4a77e3d645d197360814fdc222a908846eeb814de5e0bdb"
+    "e0323a0a4906245cc2d3ac629195e479e7c8376d8dd54ea96c56f4ea657aae08"
+    "ba78252e1ca6b4c6e8dd741f4bbd8b8a703eb5664803f60e613557b986c11d9e"
+    "e1f8981169d98e949b1e87e9ce5528df8ca1890dbfe6426841992d0fb054bb16")
+
+_RCON = (0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36,
+         0x6C, 0xD8, 0xAB, 0x4D)
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    if a & 0x100:
+        a ^= 0x11B
+    return a & 0xFF
+
+
+def _expand_key(key: bytes) -> list[list[int]]:
+    nk = len(key) // 4
+    nr = {4: 10, 6: 12, 8: 14}[nk]
+    words = [list(key[4 * i:4 * i + 4]) for i in range(nk)]
+    for i in range(nk, 4 * (nr + 1)):
+        w = list(words[i - 1])
+        if i % nk == 0:
+            w = w[1:] + w[:1]
+            w = [_SBOX[b] for b in w]
+            w[0] ^= _RCON[i // nk - 1]
+        elif nk > 6 and i % nk == 4:
+            w = [_SBOX[b] for b in w]
+        words.append([words[i - nk][j] ^ w[j] for j in range(4)])
+    # group into one flat 16-byte round key per round
+    return [sum(words[4 * r:4 * r + 4], []) for r in range(nr + 1)]
+
+
+def _aes_encrypt_block(round_keys: list[list[int]], block: bytes) -> bytes:
+    s = [block[i] ^ round_keys[0][i] for i in range(16)]
+    nr = len(round_keys) - 1
+    for rnd in range(1, nr):
+        # SubBytes + ShiftRows (column-major state layout)
+        s = [_SBOX[s[(i + 4 * (i % 4)) % 16]] for i in range(16)]
+        # MixColumns
+        t = []
+        for c in range(0, 16, 4):
+            a0, a1, a2, a3 = s[c:c + 4]
+            x = a0 ^ a1 ^ a2 ^ a3
+            t.extend((a0 ^ x ^ _xtime(a0 ^ a1), a1 ^ x ^ _xtime(a1 ^ a2),
+                      a2 ^ x ^ _xtime(a2 ^ a3), a3 ^ x ^ _xtime(a3 ^ a0)))
+        s = [t[i] ^ round_keys[rnd][i] for i in range(16)]
+    s = [_SBOX[s[(i + 4 * (i % 4)) % 16]] ^ round_keys[nr][i]
+         for i in range(16)]
+    return bytes(s)
+
+
+# ---------------------------------------------------------------------------
+# Raw cipher API (`cryptography.hazmat.primitives.ciphers`): the slice the
+# XOFs/IDPF use — AES-ECB block encryption and streaming AES-CTR.
+# ---------------------------------------------------------------------------
+
+
+class algorithms:
+    class AES:
+        def __init__(self, key: bytes):
+            self.key = bytes(key)
+
+
+class modes:
+    class ECB:
+        pass
+
+    class CTR:
+        def __init__(self, nonce: bytes):
+            self.nonce = bytes(nonce)
+
+
+class _EcbEncryptor:
+    def __init__(self, round_keys):
+        self._rk = round_keys
+
+    def update(self, data: bytes) -> bytes:
+        data = bytes(data)
+        if len(data) % 16:
+            raise ValueError("ECB input must be a multiple of the block size")
+        return b"".join(_aes_encrypt_block(self._rk, data[i:i + 16])
+                        for i in range(0, len(data), 16))
+
+    def finalize(self) -> bytes:
+        return b""
+
+
+class _CtrEncryptor:
+    """Streaming CTR keystream: 128-bit big-endian counter, partial-block
+    state carried across update() calls (matches cryptography's modes.CTR)."""
+
+    def __init__(self, round_keys, nonce: bytes):
+        self._rk = round_keys
+        self._counter = int.from_bytes(nonce, "big")
+        self._leftover = b""
+
+    def update(self, data: bytes) -> bytes:
+        data = bytes(data)
+        out = bytearray()
+        pos = 0
+        if self._leftover:
+            take = min(len(self._leftover), len(data))
+            out.extend(b ^ k for b, k in zip(data[:take], self._leftover))
+            self._leftover = self._leftover[take:]
+            pos = take
+        while pos < len(data):
+            ks = _aes_encrypt_block(self._rk,
+                                    self._counter.to_bytes(16, "big"))
+            self._counter = (self._counter + 1) & ((1 << 128) - 1)
+            chunk = data[pos:pos + 16]
+            out.extend(b ^ k for b, k in zip(chunk, ks))
+            self._leftover = ks[len(chunk):]
+            pos += 16
+        return bytes(out)
+
+    def finalize(self) -> bytes:
+        return b""
+
+
+class Cipher:
+    def __init__(self, algorithm, mode):
+        if not isinstance(algorithm, algorithms.AES):
+            raise ValueError("softcrypto Cipher supports AES only")
+        self._rk = _expand_key(algorithm.key)
+        self._mode = mode
+
+    def encryptor(self):
+        if isinstance(self._mode, modes.ECB):
+            return _EcbEncryptor(self._rk)
+        if isinstance(self._mode, modes.CTR):
+            return _CtrEncryptor(self._rk, self._mode.nonce)
+        raise ValueError("softcrypto Cipher supports ECB and CTR only")
+
+
+# ---------------------------------------------------------------------------
+# GCM (NIST SP 800-38D)
+# ---------------------------------------------------------------------------
+
+
+def _ghash_table(h_bytes: bytes) -> list[int]:
+    """Htab[i] = H * x^i in GF(2^128) (GCM bit order), for xor-accumulation."""
+    R = 0xE1000000000000000000000000000000
+    v = int.from_bytes(h_bytes, "big")
+    tab = []
+    for _ in range(128):
+        tab.append(v)
+        v = (v >> 1) ^ R if v & 1 else v >> 1
+    return tab
+
+
+def _ghash(tab: list[int], data: bytes) -> int:
+    y = 0
+    for i in range(0, len(data), 16):
+        blk = data[i:i + 16]
+        y ^= int.from_bytes(blk.ljust(16, b"\x00"), "big")
+        z = 0
+        bit = 127
+        while y:
+            top = y.bit_length() - 1
+            z ^= tab[127 - top]
+            y ^= 1 << top
+            bit = top
+        y = z
+    return y
+
+
+class AESGCM:
+    def __init__(self, key: bytes):
+        if len(key) not in (16, 24, 32):
+            raise ValueError("AESGCM key must be 128, 192 or 256 bits")
+        self._rk = _expand_key(bytes(key))
+        self._tab = _ghash_table(_aes_encrypt_block(self._rk, b"\x00" * 16))
+
+    @staticmethod
+    def generate_key(bit_length: int) -> bytes:
+        return _os.urandom(bit_length // 8)
+
+    def _ctr(self, j0: int, data: bytes) -> bytes:
+        out = bytearray()
+        ctr = j0
+        for i in range(0, len(data), 16):
+            ctr = (ctr & ~0xFFFFFFFF) | ((ctr + 1) & 0xFFFFFFFF)
+            ks = _aes_encrypt_block(self._rk, ctr.to_bytes(16, "big"))
+            chunk = data[i:i + 16]
+            out.extend(b ^ k for b, k in zip(chunk, ks))
+        return bytes(out)
+
+    def _j0(self, nonce: bytes) -> int:
+        if len(nonce) == 12:
+            return int.from_bytes(nonce + b"\x00\x00\x00\x01", "big")
+        pad = (16 - len(nonce) % 16) % 16
+        blob = nonce + b"\x00" * (pad + 8) + (8 * len(nonce)).to_bytes(8, "big")
+        return _ghash(self._tab, blob)
+
+    def _tag(self, j0: int, aad: bytes, ct: bytes) -> bytes:
+        pad_a = (16 - len(aad) % 16) % 16
+        pad_c = (16 - len(ct) % 16) % 16
+        blob = (aad + b"\x00" * pad_a + ct + b"\x00" * pad_c
+                + (8 * len(aad)).to_bytes(8, "big")
+                + (8 * len(ct)).to_bytes(8, "big"))
+        s = _ghash(self._tab, blob)
+        ek = _aes_encrypt_block(self._rk, j0.to_bytes(16, "big"))
+        return (s ^ int.from_bytes(ek, "big")).to_bytes(16, "big")
+
+    def encrypt(self, nonce: bytes, data: bytes, associated_data) -> bytes:
+        aad = associated_data or b""
+        j0 = self._j0(bytes(nonce))
+        ct = self._ctr(j0, bytes(data))
+        return ct + self._tag(j0, bytes(aad), ct)
+
+    def decrypt(self, nonce: bytes, data: bytes, associated_data) -> bytes:
+        data = bytes(data)
+        if len(data) < 16:
+            raise InvalidTag("ciphertext shorter than the GCM tag")
+        aad = associated_data or b""
+        ct, tag = data[:-16], data[-16:]
+        j0 = self._j0(bytes(nonce))
+        if not _hmac.compare_digest(self._tag(j0, bytes(aad), ct), tag):
+            raise InvalidTag("GCM tag mismatch")
+        return self._ctr(j0, ct)
+
+
+# ---------------------------------------------------------------------------
+# ChaCha20-Poly1305 (RFC 8439)
+# ---------------------------------------------------------------------------
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _chacha_block(key_words, counter: int, nonce_words) -> bytes:
+    def rotl(v, n):
+        return ((v << n) | (v >> (32 - n))) & _MASK32
+
+    state = [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574,
+             *key_words, counter, *nonce_words]
+    w = list(state)
+
+    def qr(a, b, c, d):
+        w[a] = (w[a] + w[b]) & _MASK32; w[d] = rotl(w[d] ^ w[a], 16)
+        w[c] = (w[c] + w[d]) & _MASK32; w[b] = rotl(w[b] ^ w[c], 12)
+        w[a] = (w[a] + w[b]) & _MASK32; w[d] = rotl(w[d] ^ w[a], 8)
+        w[c] = (w[c] + w[d]) & _MASK32; w[b] = rotl(w[b] ^ w[c], 7)
+
+    for _ in range(10):
+        qr(0, 4, 8, 12); qr(1, 5, 9, 13); qr(2, 6, 10, 14); qr(3, 7, 11, 15)
+        qr(0, 5, 10, 15); qr(1, 6, 11, 12); qr(2, 7, 8, 13); qr(3, 4, 9, 14)
+    return b"".join(((w[i] + state[i]) & _MASK32).to_bytes(4, "little")
+                    for i in range(16))
+
+
+def _poly1305(key32: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little") \
+        & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        blk = msg[i:i + 16]
+        acc = ((acc + int.from_bytes(blk, "little")
+                + (1 << (8 * len(blk)))) * r) % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+class ChaCha20Poly1305:
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 256 bits")
+        self._kw = [int.from_bytes(key[4 * i:4 * i + 4], "little")
+                    for i in range(8)]
+
+    @staticmethod
+    def generate_key() -> bytes:
+        return _os.urandom(32)
+
+    def _stream(self, nonce: bytes, data: bytes, first_counter: int) -> bytes:
+        nw = [int.from_bytes(nonce[4 * i:4 * i + 4], "little")
+              for i in range(3)]
+        out = bytearray()
+        for i in range(0, len(data), 64):
+            ks = _chacha_block(self._kw, first_counter + i // 64, nw)
+            out.extend(b ^ k for b, k in zip(data[i:i + 64], ks))
+        return bytes(out)
+
+    def _mac(self, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+        nw = [int.from_bytes(nonce[4 * i:4 * i + 4], "little")
+              for i in range(3)]
+        otk = _chacha_block(self._kw, 0, nw)[:32]
+        pad_a = (16 - len(aad) % 16) % 16
+        pad_c = (16 - len(ct) % 16) % 16
+        blob = (aad + b"\x00" * pad_a + ct + b"\x00" * pad_c
+                + len(aad).to_bytes(8, "little")
+                + len(ct).to_bytes(8, "little"))
+        return _poly1305(otk, blob)
+
+    def encrypt(self, nonce: bytes, data: bytes, associated_data) -> bytes:
+        nonce, data = bytes(nonce), bytes(data)
+        aad = bytes(associated_data or b"")
+        ct = self._stream(nonce, data, 1)
+        return ct + self._mac(nonce, aad, ct)
+
+    def decrypt(self, nonce: bytes, data: bytes, associated_data) -> bytes:
+        nonce, data = bytes(nonce), bytes(data)
+        if len(data) < 16:
+            raise InvalidTag("ciphertext shorter than the Poly1305 tag")
+        aad = bytes(associated_data or b"")
+        ct, tag = data[:-16], data[-16:]
+        if not _hmac.compare_digest(self._mac(nonce, aad, ct), tag):
+            raise InvalidTag("Poly1305 tag mismatch")
+        return self._stream(nonce, ct, 1)
+
+
+# ---------------------------------------------------------------------------
+# X25519 (RFC 7748)
+# ---------------------------------------------------------------------------
+
+_P25519 = (1 << 255) - 19
+_A24 = 121665
+
+
+def _x25519(k_bytes: bytes, u_bytes: bytes) -> bytes:
+    k = int.from_bytes(k_bytes, "little")
+    k &= ~(7 << 0) & ((1 << 256) - 1)
+    k &= ~(1 << 255)
+    k |= 1 << 254
+    u = int.from_bytes(u_bytes, "little") & ((1 << 255) - 1)
+    p = _P25519
+    x1, x2, z2, x3, z3 = u, 1, 0, u, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        kt = (k >> t) & 1
+        if swap ^ kt:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % p
+        aa = a * a % p
+        b = (x2 - z2) % p
+        bb = b * b % p
+        e = (aa - bb) % p
+        c = (x3 + z3) % p
+        d = (x3 - z3) % p
+        da = d * a % p
+        cb = c * b % p
+        x3 = (da + cb) % p
+        x3 = x3 * x3 % p
+        z3 = (da - cb) % p
+        z3 = u * (z3 * z3 % p) % p
+        x2 = aa * bb % p
+        z2 = e * (aa + _A24 * e) % p
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, p - 2, p) % p
+    return out.to_bytes(32, "little")
+
+
+class X25519PublicKey:
+    def __init__(self, raw: bytes):
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "X25519PublicKey":
+        if len(data) != 32:
+            raise ValueError("X25519 public key must be 32 bytes")
+        return cls(data)
+
+    def public_bytes_raw(self) -> bytes:
+        return self._raw
+
+
+class X25519PrivateKey:
+    def __init__(self, raw: bytes):
+        self._raw = bytes(raw)
+
+    @classmethod
+    def generate(cls) -> "X25519PrivateKey":
+        return cls(_os.urandom(32))
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "X25519PrivateKey":
+        if len(data) != 32:
+            raise ValueError("X25519 private key must be 32 bytes")
+        return cls(data)
+
+    def private_bytes_raw(self) -> bytes:
+        return self._raw
+
+    def public_key(self) -> X25519PublicKey:
+        base = (9).to_bytes(32, "little")
+        return X25519PublicKey(_x25519(self._raw, base))
+
+    def exchange(self, peer_public_key: X25519PublicKey) -> bytes:
+        shared = _x25519(self._raw, peer_public_key.public_bytes_raw())
+        if shared == b"\x00" * 32:
+            raise ValueError("X25519 exchange produced the zero point")
+        return shared
+
+
+# ---------------------------------------------------------------------------
+# P-256 ECDH (NIST SP 800-186) + the ec/serialization API shims
+# ---------------------------------------------------------------------------
+
+_P256_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+_P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+_P256_B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+_P256_G = (
+    0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296,
+    0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5,
+)
+
+
+def _p256_add(p1, p2):
+    p = _P256_P
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % p == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1 - 3) * pow(2 * y1, p - 2, p) % p
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, p - 2, p) % p
+    x3 = (lam * lam - x1 - x2) % p
+    y3 = (lam * (x1 - x3) - y1) % p
+    return (x3, y3)
+
+
+def _p256_mul(k: int, point):
+    acc = None
+    add = point
+    while k:
+        if k & 1:
+            acc = _p256_add(acc, add)
+        add = _p256_add(add, add)
+        k >>= 1
+    return acc
+
+
+class _EllipticCurvePublicKey:
+    def __init__(self, point):
+        self._point = point
+
+    @classmethod
+    def from_encoded_point(cls, curve, data: bytes):
+        data = bytes(data)
+        if len(data) != 65 or data[0] != 4:
+            raise ValueError("only uncompressed X9.62 points are supported")
+        x = int.from_bytes(data[1:33], "big")
+        y = int.from_bytes(data[33:], "big")
+        if (y * y - (x * x * x - 3 * x + _P256_B)) % _P256_P != 0:
+            raise ValueError("point is not on P-256")
+        return cls((x, y))
+
+    def public_bytes(self, encoding, format) -> bytes:
+        x, y = self._point
+        return b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+class _EllipticCurvePrivateKey:
+    def __init__(self, d: int):
+        self._d = d
+
+    def private_numbers(self):
+        class _Numbers:
+            def __init__(self, value):
+                self.private_value = value
+
+        return _Numbers(self._d)
+
+    def public_key(self) -> _EllipticCurvePublicKey:
+        return _EllipticCurvePublicKey(_p256_mul(self._d, _P256_G))
+
+    def exchange(self, algorithm, peer_public_key) -> bytes:
+        point = _p256_mul(self._d, peer_public_key._point)
+        if point is None:
+            raise ValueError("ECDH produced the point at infinity")
+        return point[0].to_bytes(32, "big")
+
+
+class _EcNamespace:
+    """Shim for `cryptography.hazmat.primitives.asymmetric.ec`."""
+
+    EllipticCurvePublicKey = _EllipticCurvePublicKey
+    EllipticCurvePrivateKey = _EllipticCurvePrivateKey
+
+    class SECP256R1:
+        name = "secp256r1"
+
+    class ECDH:
+        pass
+
+    @staticmethod
+    def generate_private_key(curve) -> _EllipticCurvePrivateKey:
+        d = 0
+        while not 1 <= d < _P256_N:
+            d = int.from_bytes(_os.urandom(32), "big")
+        return _EllipticCurvePrivateKey(d)
+
+    @staticmethod
+    def derive_private_key(private_value: int, curve) -> _EllipticCurvePrivateKey:
+        if not 1 <= private_value < _P256_N:
+            raise ValueError("private value out of range for P-256")
+        return _EllipticCurvePrivateKey(private_value)
+
+
+class _SerializationNamespace:
+    """Shim for `cryptography.hazmat.primitives.serialization`."""
+
+    class Encoding:
+        X962 = "X962"
+
+    class PublicFormat:
+        UncompressedPoint = "UncompressedPoint"
+
+
+ec = _EcNamespace
+serialization = _SerializationNamespace

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from janus_tpu import profiler
 from janus_tpu.ops import xof_batch
 from janus_tpu.ops.flp_batch import BatchFlp, field_ops
 from janus_tpu.vdaf import ping_pong
@@ -637,20 +638,29 @@ class BatchPrio3:
             return verify_key[i] if per_report_vk else verify_key
 
         if not self.device_ok:
-            return [
+            t_host = time.monotonic()
+            out = [
                 self._host_helper(vk_for(i), nonces[i], public_shares[i],
                                   input_shares[i], inbound_messages[i])
                 for i in range(N)
             ]
+            profiler.record_batch(
+                "helper_init", type(self.vdaf).__name__, bucket=N, reports=N,
+                decode_s=0.0, device_s=time.monotonic() - t_host,
+                encode_s=0.0, device=False)
+            return out
 
         t_begin = time.monotonic()
         chunk_sizes = self._chunk_plan(N)
         M = sum(chunk_sizes) if chunk_sizes else self._bucket(N)
+        # cold-compile detection must precede the dispatch: the first call
+        # for a bucket shape pays the XLA compile inside the kernel call
+        cold = (any(c not in self._helper_fns for c in chunk_sizes)
+                if chunk_sizes else M not in self._helper_fns)
         ss = self.vdaf.SEED_SIZE
         packed, lverif, decode_err = self._pack_helper_inputs(
             M, verify_key, nonces, public_shares, input_shares,
             inbound_messages)
-        from janus_tpu.metrics import device_batch_reports, device_batch_seconds
 
         t0 = time.monotonic()
         # Only the small per-lane outputs come back to the host; the output
@@ -679,9 +689,6 @@ class BatchPrio3:
         jr_ok = packed_out[:, ss + 1].astype(bool)
         fallback = packed_out[:, ss + 2].astype(bool)
         t_dev = time.monotonic()
-        device_batch_seconds.observe(t_dev - t0, kind="helper_init",
-                                     bucket=M)
-        device_batch_reports.add(N, kind="helper_init")
 
         # Assembly: per-report Python is the GIL-bound bracket around the
         # kernel, so keep it lean — one .tolist()/.tobytes() per array
@@ -721,6 +728,11 @@ class BatchPrio3:
             tm["device"] += t_dev - t0
             tm["encode"] += t_end - t_dev
             tm["batches"] += 1
+        profiler.record_batch(
+            "helper_init", type(self.vdaf).__name__, bucket=M, reports=N,
+            decode_s=t0 - t_begin, device_s=t_dev - t0,
+            encode_s=t_end - t_dev,
+            compile_state="cold" if cold else "warm")
         return out
 
     def leader_init_batch(
@@ -745,12 +757,20 @@ class BatchPrio3:
             return verify_key[i] if per_report_vk else verify_key
 
         if not self.device_ok:
-            return [
+            t_host = time.monotonic()
+            out = [
                 self._host_leader(vk_for(i), nonces[i], public_shares[i],
                                   input_shares[i])
                 for i in range(N)
             ]
+            profiler.record_batch(
+                "leader_init", type(self.vdaf).__name__, bucket=N, reports=N,
+                decode_s=0.0, device_s=time.monotonic() - t_host,
+                encode_s=0.0, device=False)
+            return out
+        t_begin = time.monotonic()
         M = self._bucket(N)
+        cold = M not in self._leader_fns
         ss = self.vdaf.SEED_SIZE
         ks = self.vdaf.VERIFY_KEY_SIZE
         meas_raw = np.zeros((M, self.flp.MEAS_LEN, self.L), dtype=np.uint32)
@@ -804,12 +824,14 @@ class BatchPrio3:
             vk[:N] = np.frombuffer(verify_key, dtype=np.uint8)
         fn = self._leader_fn(M)
         nonce_rows[:N] = nonces_arr(nonces)
+        t0 = time.monotonic()
         # The leader's verifier IS wire payload (PrepareInit prep share), so
         # it must come to the host; output shares stay on device.
         verif_raw_d, packed_out_d, out_share_d = fn(
             packed, meas_raw, proofs_raw)
         verif_raw = np.asarray(verif_raw_d)
         packed_out = np.asarray(packed_out_d)
+        t_dev = time.monotonic()
         own_part = packed_out[:, :ss]
         state_seed = packed_out[:, ss:2 * ss]
         fallback = packed_out[:, 2 * ss].astype(bool)
@@ -841,6 +863,18 @@ class BatchPrio3:
                 prep_share=prep_share, state=state,
                 device_shares=out_share_d, lane=i,
             ))
+        t_end = time.monotonic()
+        with self._timings_lock:
+            tm = self.timings
+            tm["decode"] += t0 - t_begin
+            tm["device"] += t_dev - t0
+            tm["encode"] += t_end - t_dev
+            tm["batches"] += 1
+        profiler.record_batch(
+            "leader_init", type(self.vdaf).__name__, bucket=M, reports=N,
+            decode_s=t0 - t_begin, device_s=t_dev - t0,
+            encode_s=t_end - t_dev,
+            compile_state="cold" if cold else "warm")
         return out
 
     # -- host fallbacks ----------------------------------------------------

@@ -23,8 +23,11 @@ at ~2^-32 per sampled element).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
+from janus_tpu import profiler
 from janus_tpu.engine.host import HostPrepEngine
 from janus_tpu.vdaf import idpf as _idpf
 from janus_tpu.vdaf import ping_pong
@@ -413,6 +416,7 @@ class BatchPoplar1(HostPrepEngine):
                 fast = [fast[j] for j in keep.tolist()]
                 arr = arr[keep]
         if fast:
+            t_begin = time.perf_counter()
             k = len(fast)
             N = bucket_size(k)
             sec = arr[:, cw_start:cw_start + 17 * self.vdaf.bits].reshape(
@@ -460,11 +464,14 @@ class BatchPoplar1(HostPrepEngine):
             vk_rows = np.broadcast_to(
                 np.frombuffer(verify_key, dtype=np.uint8),
                 (N, len(verify_key)))
+            cold = ("hfast", N, P, level) not in self._fns
             fn = self._helper_fast_fn(N, P, level)
+            t_pack = time.perf_counter()
             bundle = np.asarray(fn(
                 vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
                 corr_seeds, nonce_rows, pb,
                 np.ascontiguousarray(lr1.transpose(2, 1, 0))))
+            t_dev = time.perf_counter()
             flags = bundle[0, 7, :k]
 
             # columnar encodes (one pass each, no per-report bigints):
@@ -513,6 +520,12 @@ class BatchPoplar1(HostPrepEngine):
                         ob_blob[j * obrow:(j + 1) * obrow]),
                     state=_LazyContinued(self.vdaf, sb),
                     prep_share=sb)
+            profiler.record_batch(
+                "poplar1_helper_init", type(self.vdaf).__name__, bucket=N,
+                reports=k, decode_s=t_pack - t_begin,
+                device_s=t_dev - t_pack,
+                encode_s=time.perf_counter() - t_dev,
+                compile_state="cold" if cold else "warm")
         if slow:
             slow_res = self._helper_init_oracle(
                 verify_key, nonces, public_shares, input_shares,

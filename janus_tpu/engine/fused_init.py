@@ -30,11 +30,13 @@ from __future__ import annotations
 
 import struct
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from janus_tpu import profiler
 from janus_tpu.ops import hpke_device, x25519
 from janus_tpu.vdaf import ping_pong
 
@@ -45,12 +47,14 @@ _U32 = jnp.uint32
 class FusedLaunch:
     """An in-flight fused program: dispatched, not yet materialized."""
 
-    def __init__(self, out_d, share_d, n: int, ss: int, has_jr: bool):
+    def __init__(self, out_d, share_d, n: int, ss: int, has_jr: bool,
+                 profile: dict | None = None):
         self._out_d = out_d
         self.device_shares = share_d  # [L, OUT, M], resident
         self.n = n
         self._ss = ss if has_jr else 0
         self._res = None
+        self._profile = profile
 
     def fetch(self) -> dict:
         """Block on the single device->host transfer; split the columns.
@@ -59,6 +63,13 @@ class FusedLaunch:
         pt_ok, msg_ok, range_ok, proof_ok, jr_ok, fallback."""
         if self._res is None:
             out = np.asarray(self._out_d)[: self.n]
+            if self._profile is not None:
+                p = self._profile
+                profiler.record_batch(
+                    "fused_helper_init", p["vdaf"], bucket=p["bucket"],
+                    reports=self.n, decode_s=p["decode_s"],
+                    device_s=time.perf_counter() - p["t_dispatch"],
+                    encode_s=0.0, compile_state=p["compile_state"])
             ss = self._ss
             flags = out[:, ss:].astype(bool)
             self._res = {
@@ -260,6 +271,7 @@ class FusedHelperInit:
                 or ml < 5):
             return None
 
+        t_begin = time.perf_counter()
         M = hpke_device._bucket(n)
         ks = e.vdaf.VERIFY_KEY_SIZE
         body_arr = np.frombuffer(body, np.uint8)
@@ -285,9 +297,15 @@ class FusedHelperInit:
         gather(7, cl, 56)           # ciphertext+tag
         gather(2, pl, 56 + cl)      # public share
         gather(9, ml, 56 + cl + pl)  # leader ping-pong message
+        with self._lock:
+            cold = (M, cl, pl, ml) not in self._fns
         fn = self._fn(M, cl, pl, ml)
+        t_pack = time.perf_counter()
         out_d, share_d = fn(const_row, lanes)
-        return FusedLaunch(out_d, share_d, n, ss, e.has_jr)
+        return FusedLaunch(out_d, share_d, n, ss, e.has_jr, profile={
+            "vdaf": type(e.vdaf).__name__, "bucket": M,
+            "decode_s": t_pack - t_begin, "t_dispatch": t_pack,
+            "compile_state": "cold" if cold else "warm"})
 
 
 _attach_lock = threading.Lock()

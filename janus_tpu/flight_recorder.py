@@ -1,0 +1,97 @@
+"""Per-job flight recorder: a bounded ring buffer of recent job lifecycle
+events, surfaced at /debug/jobs (janus_tpu.health).
+
+The job drivers (aggregation_job_driver.py, collection_job_driver.py) and
+the aggregator core record coarse lifecycle events — lease acquired, step
+completed, device batch launched, step failure (with the step-failure
+type), job abandoned — so an operator can answer "what happened to job X
+in the last few minutes" without trawling logs.  Events carry the active
+trace id when recorded inside a span, linking the recorder to exported
+spans and JSON log lines.
+
+Ring capacity comes from JANUS_FLIGHT_RECORDER_SIZE (default 512).
+Recording is lock-guarded and allocation-light; like every observability
+hook in this codebase it must never take the data plane down, so record()
+swallows its own failures.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+
+def _capacity() -> int:
+    try:
+        return max(1, int(os.environ.get("JANUS_FLIGHT_RECORDER_SIZE",
+                                         "512")))
+    except ValueError:
+        return 512
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int | None = None):
+        self._events: deque = deque(maxlen=capacity or _capacity())
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, event: str, *, task_id=None, job_id=None,
+               **fields) -> None:
+        try:
+            from janus_tpu import trace
+
+            ctx = trace.current_context()
+            rec = {"ts": time.time(), "event": event}
+            if task_id is not None:
+                rec["task_id"] = str(task_id)
+            if job_id is not None:
+                rec["job_id"] = str(job_id)
+            if ctx is not None:
+                rec["trace_id"] = ctx.trace_id
+            for k, v in fields.items():
+                rec[k] = v if isinstance(v, (int, float, bool,
+                                             type(None))) else str(v)
+            with self._lock:
+                self._seq += 1
+                rec["seq"] = self._seq
+                self._events.append(rec)
+        except Exception:
+            pass  # the recorder must never take the data plane down
+
+    def snapshot(self, job_id: str | None = None,
+                 limit: int | None = None) -> list[dict]:
+        """Recent events, oldest first; optionally filtered by job id."""
+        with self._lock:
+            events = list(self._events)
+        if job_id is not None:
+            events = [e for e in events if e.get("job_id") == str(job_id)]
+        if limit is not None:
+            events = events[-limit:]
+        return events
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen or 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+
+
+RECORDER = FlightRecorder()
+
+
+def record(event: str, *, task_id=None, job_id=None, **fields) -> None:
+    """Record onto the process-global ring (module-level convenience)."""
+    RECORDER.record(event, task_id=task_id, job_id=job_id, **fields)
+
+
+def snapshot(job_id: str | None = None, limit: int | None = None) -> list[dict]:
+    return RECORDER.snapshot(job_id=job_id, limit=limit)
+
+
+def clear() -> None:
+    RECORDER.clear()

@@ -4,11 +4,19 @@ Prometheus exposition per metrics.rs; /debug/state is the analog of the
 reference's feature-gated tokio-console runtime introspection,
 trace.rs:66).
 
-    GET /healthz      -> 200 "ok"
-    GET /metrics      -> Prometheus text format
-    GET /debug/state  -> JSON: threads (name/state/stack top), device
-                         engines (fallbacks, cumulative time split,
-                         compiled-kernel count), process stats
+    GET /healthz        -> 200 "ok"
+    GET /metrics        -> Prometheus text format
+    GET /debug/state    -> JSON: threads (name/state/stack top), device
+                           engines (fallbacks, cumulative time split,
+                           compiled-kernel count), process stats
+    GET /debug/jobs     -> JSON: flight-recorder ring of recent per-job
+                           lifecycle events (?job_id= filters, ?limit=
+                           caps the tail)
+    GET /debug/profile  -> JSON: per-batch device-engine phase records
+                           (decode/compile/execute/encode, occupancy)
+                           plus aggregate summary and per-engine totals
+
+The /debug/* routes share the JANUS_DEBUG_CONSOLE gate.
 """
 
 from __future__ import annotations
@@ -80,6 +88,56 @@ def _debug_state() -> dict:
     }
 
 
+def _debug_jobs(query: dict) -> dict:
+    from janus_tpu import flight_recorder
+
+    job_id = query.get("job_id")
+    limit = None
+    if query.get("limit"):
+        try:
+            limit = max(1, int(query["limit"]))
+        except ValueError:
+            limit = None
+    events = flight_recorder.snapshot(job_id=job_id, limit=limit)
+    return {
+        "capacity": flight_recorder.RECORDER.capacity,
+        "count": len(events),
+        "events": events,
+    }
+
+
+def _debug_profile(query: dict) -> dict:
+    from janus_tpu import profiler
+
+    limit = None
+    if query.get("limit"):
+        try:
+            limit = max(1, int(query["limit"]))
+        except ValueError:
+            limit = None
+    engines = []
+    with _engines_lock:
+        snapshot = list(_engines)
+    for e in snapshot:
+        try:
+            tm = dict(getattr(e, "timings", {}) or {})
+            engines.append({
+                "vdaf": type(getattr(e, "vdaf", None)).__name__,
+                "device": bool(getattr(e, "device_ok", False)),
+                "cumulative_seconds": {
+                    k: round(float(v), 3)
+                    for k, v in tm.items() if k != "batches"},
+                "batches": int(tm.get("batches", 0)),
+            })
+        except Exception:
+            continue
+    return {
+        "batches": profiler.snapshot(limit=limit),
+        "summary": profiler.summary(),
+        "engines": engines,
+    }
+
+
 def _debug_console_enabled() -> bool:
     """The runtime console is opt-in (reference gates tokio-console behind a
     feature flag, trace.rs:66): it exposes thread stacks and engine
@@ -104,15 +162,27 @@ class HealthServer:
 
             def do_GET(self):
                 status = 200
-                if self.path == "/healthz":
+                path, _, rawq = self.path.partition("?")
+                query = {}
+                for part in rawq.split("&"):
+                    if "=" in part:
+                        k, _, v = part.partition("=")
+                        query[k] = v
+                if path == "/healthz":
                     body = b"ok"
                     ctype = "text/plain"
-                elif self.path == "/metrics":
+                elif path == "/metrics":
                     body = REGISTRY.exposition().encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path == "/debug/state" and debug_console:
+                elif path in ("/debug/state", "/debug/jobs",
+                              "/debug/profile") and debug_console:
                     try:
-                        body = json.dumps(_debug_state(), indent=1).encode()
+                        payload = {"/debug/state": _debug_state,
+                                   "/debug/jobs": _debug_jobs,
+                                   "/debug/profile": _debug_profile}[path]
+                        data = (payload() if path == "/debug/state"
+                                else payload(query))
+                        body = json.dumps(data, indent=1).encode()
                         ctype = "application/json"
                     except Exception as e:  # introspection must not 500 the
                         status = 500        # probe port with a dropped conn

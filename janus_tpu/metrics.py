@@ -93,8 +93,50 @@ class Histogram:
         return out
 
 
+class Gauge:
+    """Last-value instrument (Prometheus `gauge`): set() overwrites."""
+
+    is_gauge = True
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def snapshot(self) -> list[tuple]:
+        """[(label_key, value)] for exporters (janus_tpu.otlp)."""
+        with self._lock:
+            return sorted(self._values.items())
+
+    def _render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_labelstr(key)} {v}")
+        return out
+
+
+def _escape_label_value(v) -> str:
+    # Prometheus text format: backslash, double-quote and newline must be
+    # escaped inside label values or the whole exposition is corrupted
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _labelstr(key, le=None) -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in key]
     if le is not None:
         parts.append(f'le="{le}"')
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -123,6 +165,15 @@ class Registry:
             h = Histogram(name, help_, buckets)
             self._metrics.append(h)
             return h
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            for m_ in self._metrics:
+                if m_.name == name and isinstance(m_, Gauge):
+                    return m_
+            g = Gauge(name, help_)
+            self._metrics.append(g)
+            return g
 
     def exposition(self) -> str:
         lines: list[str] = []
@@ -163,8 +214,92 @@ device_batch_seconds = REGISTRY.histogram(
     "janus_device_batch_seconds", "device prepare-kernel latency by batch bucket")
 device_batch_reports = REGISTRY.counter(
     "janus_device_batch_reports", "reports processed by the device engine")
+# device profiler instruments (per-batch phase records from engine/batch.py,
+# fused_init.py and batch_poplar1.py via janus_tpu.profiler)
+device_batch_phase_seconds = REGISTRY.histogram(
+    "janus_device_batch_phase_seconds",
+    "per-batch phase latency (decode/device/encode) by engine kind")
+device_batch_occupancy = REGISTRY.histogram(
+    "janus_device_batch_occupancy",
+    "real reports / padded bucket size per device batch",
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
+device_batch_padded_lanes = REGISTRY.counter(
+    "janus_device_batch_padded_lanes",
+    "padding lanes submitted to the device (bucket size minus real reports)")
+device_padding_waste_ratio = REGISTRY.gauge(
+    "janus_device_padding_waste_ratio",
+    "cumulative fraction of device lanes wasted on padding, by engine kind")
+device_batch_compiles = REGISTRY.counter(
+    "janus_device_batch_compiles",
+    "device batches that paid a cold kernel compile, by kind/bucket")
 
 
 def all_instruments() -> list:
     """Every registered instrument, for exporters (janus_tpu.otlp)."""
     return REGISTRY.all()
+
+
+# -- Prometheus text-format lint (CI smoke: a malformed instrument must
+#    never ship silently) --------------------------------------------------
+
+_METRIC_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL_VALUE_RE = r'(?:[^"\\\n]|\\\\|\\"|\\n)*'  # escaped per the spec
+_LABELS_RE = (r"\{(?:[a-zA-Z_][a-zA-Z0-9_]*=\"" + _LABEL_VALUE_RE +
+              r"\"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"" + _LABEL_VALUE_RE +
+              r"\")*)?\}")
+_NUMBER_RE = (r"(?:[-+]?(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][-+]?\d+)?"
+              r"|[-+]?Inf|NaN)")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def lint_exposition(text: str) -> list[str]:
+    """Validate a Prometheus text-format exposition against the grammar
+    (https://prometheus.io/docs/instrumenting/exposition_formats/).
+
+    Pure-regex, no network.  Returns a list of human-readable problems;
+    an empty list means the exposition is well-formed.
+    """
+    import re
+
+    errors: list[str] = []
+    sample_re = re.compile(
+        r"^(" + _METRIC_NAME_RE + r")(" + _LABELS_RE + r")?\s+("
+        + _NUMBER_RE + r")(\s+[-+]?\d+)?$")
+    help_re = re.compile(r"^# HELP (" + _METRIC_NAME_RE + r")(?: (.*))?$")
+    type_re = re.compile(r"^# TYPE (" + _METRIC_NAME_RE + r") (\S+)$")
+    declared: dict[str, str] = {}  # family name -> type
+    if text and not text.endswith("\n"):
+        errors.append("exposition must end with a newline")
+    for i, line in enumerate(text.splitlines(), 1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            if line.startswith("# HELP "):
+                if not help_re.match(line):
+                    errors.append(f"line {i}: malformed HELP: {line!r}")
+                continue
+            if line.startswith("# TYPE "):
+                m = type_re.match(line)
+                if not m:
+                    errors.append(f"line {i}: malformed TYPE: {line!r}")
+                elif m.group(2) not in _TYPES:
+                    errors.append(
+                        f"line {i}: unknown type {m.group(2)!r}")
+                else:
+                    declared[m.group(1)] = m.group(2)
+                continue
+            continue  # free-form comment: legal
+        m = sample_re.match(line)
+        if not m:
+            errors.append(f"line {i}: malformed sample: {line!r}")
+            continue
+        name = m.group(1)
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in declared:
+                family = name[:-len(suffix)]
+                break
+        if declared and family not in declared:
+            errors.append(
+                f"line {i}: sample {name!r} has no # TYPE declaration")
+    return errors

@@ -34,6 +34,9 @@ class OtlpConfig:
     interval_s: float = 30.0
     service_name: str = "janus_tpu"
     headers: dict = field(default_factory=dict)  # e.g. auth metadata
+    role: str | None = None  # "leader" / "helper" — distinguishes the two
+                             # aggregator processes in a shared collector
+    resource_attributes: dict = field(default_factory=dict)
 
 
 def _now_ns() -> int:
@@ -41,9 +44,14 @@ def _now_ns() -> int:
 
 
 def _resource(cfg: OtlpConfig) -> dict:
-    return {"attributes": [
+    attrs = [
         {"key": "service.name", "value": {"stringValue": cfg.service_name}},
-    ]}
+    ]
+    if cfg.role:
+        attrs.append({"key": "role", "value": {"stringValue": cfg.role}})
+    for k, v in cfg.resource_attributes.items():
+        attrs.append({"key": str(k), "value": {"stringValue": str(v)}})
+    return {"attributes": attrs}
 
 
 def _attr_list(labels) -> list:
@@ -85,6 +93,14 @@ class OtlpExporter:
                 ms.append({"name": inst.name, "description": inst.help,
                            "histogram": {"aggregationTemporality": 2,
                                          "dataPoints": points}})
+            elif getattr(inst, "is_gauge", False):
+                points = [{
+                    "attributes": _attr_list(key),
+                    "timeUnixNano": str(_now_ns()),
+                    "asDouble": v,
+                } for key, v in inst.snapshot()]
+                ms.append({"name": inst.name, "description": inst.help,
+                           "gauge": {"dataPoints": points}})
             else:  # counter
                 points = [{
                     "attributes": _attr_list(key),

@@ -1,0 +1,118 @@
+"""Per-batch device-engine profiler (ROADMAP: attribute BENCH regressions
+to a phase, not a guess).
+
+The engines (engine/batch.py, engine/fused_init.py, engine/batch_poplar1.py)
+call `record_batch(...)` once per launched batch with the phase split —
+decode (host unpack/pack), device (kernel execute, including the XLA
+compile on a cold bucket), encode (host re-encode) — plus the occupancy of
+the padded bucket.  Records land in a bounded ring surfaced at
+`/debug/profile` (janus_tpu.health) and feed the device-profiler
+instruments in janus_tpu.metrics.
+
+Whether a batch paid a cold compile is reported as a flag ("cold"/"warm"),
+detected by the caller before invoking the jitted kernel; XLA gives no
+portable way to split compile time out of the first execution, so the
+cold flag plus the device-phase histogram is the attribution signal.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from janus_tpu import metrics
+
+
+def _capacity() -> int:
+    try:
+        return max(1, int(os.environ.get("JANUS_PROFILE_SIZE", "256")))
+    except ValueError:
+        return 256
+
+
+_lock = threading.Lock()
+_records: deque = deque(maxlen=_capacity())
+# kind -> [padded_lanes_total, lanes_total] for the cumulative waste gauge
+_padding: dict[str, list] = {}
+
+
+def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
+                 decode_s: float, device_s: float, encode_s: float,
+                 compile_state: str = "warm", device: bool = True) -> None:
+    """Record one engine batch.
+
+    kind: engine entry point ("helper_init", "leader_init",
+          "fused_helper_init", "poplar1_helper_init", ...)
+    bucket: padded batch size actually launched; reports: real reports.
+    compile_state: "cold" when this launch paid the kernel compile.
+    device: False for a host-fallback batch.
+    """
+    bucket = max(int(bucket), 1)
+    reports = int(reports)
+    occupancy = min(reports / bucket, 1.0)
+    padded = max(bucket - reports, 0)
+    rec = {
+        "ts": time.time(),
+        "kind": kind,
+        "vdaf": vdaf,
+        "bucket": bucket,
+        "reports": reports,
+        "occupancy": round(occupancy, 4),
+        "padded_lanes": padded,
+        "compile": compile_state,
+        "device": bool(device),
+        "phases": {
+            "decode_s": round(decode_s, 6),
+            "device_s": round(device_s, 6),
+            "encode_s": round(encode_s, 6),
+        },
+        "total_s": round(decode_s + device_s + encode_s, 6),
+    }
+    with _lock:
+        _records.append(rec)
+        pad = _padding.setdefault(kind, [0, 0])
+        pad[0] += padded
+        pad[1] += bucket
+        waste = pad[0] / pad[1] if pad[1] else 0.0
+    metrics.device_batch_seconds.observe(device_s, kind=kind,
+                                         bucket=str(bucket))
+    metrics.device_batch_reports.add(reports, kind=kind)
+    metrics.device_batch_phase_seconds.observe(decode_s, kind=kind,
+                                               phase="decode")
+    metrics.device_batch_phase_seconds.observe(device_s, kind=kind,
+                                               phase="device")
+    metrics.device_batch_phase_seconds.observe(encode_s, kind=kind,
+                                               phase="encode")
+    metrics.device_batch_occupancy.observe(occupancy, kind=kind)
+    if padded:
+        metrics.device_batch_padded_lanes.add(padded, kind=kind)
+    metrics.device_padding_waste_ratio.set(waste, kind=kind)
+    if compile_state == "cold":
+        metrics.device_batch_compiles.add(1, kind=kind, bucket=str(bucket))
+
+
+def snapshot(limit: int | None = None) -> list[dict]:
+    """Most recent batch records, oldest first."""
+    with _lock:
+        records = list(_records)
+    if limit is not None:
+        records = records[-limit:]
+    return records
+
+
+def summary() -> dict:
+    """Cumulative per-kind padding waste for /debug/profile."""
+    with _lock:
+        return {kind: {"padded_lanes": pad[0], "total_lanes": pad[1],
+                       "waste_ratio": round(pad[0] / pad[1], 4) if pad[1]
+                       else 0.0}
+                for kind, pad in sorted(_padding.items())}
+
+
+def clear() -> None:
+    """Reset the ring and cumulative stats (tests)."""
+    with _lock:
+        _records.clear()
+        _padding.clear()

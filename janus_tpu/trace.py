@@ -18,12 +18,54 @@ from __future__ import annotations
 import contextlib
 import json as _json
 import os
+import re
 import sys
 import threading
 import time as _time
 from dataclasses import dataclass
 
 _LEVELS = {"error": 0, "warn": 1, "info": 2, "debug": 3, "trace": 4}
+
+# W3C Trace Context (https://www.w3.org/TR/trace-context/):
+#   traceparent: 00-{16-byte trace id}-{8-byte span id}-{flags}
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """Identity of a span as seen across process boundaries."""
+
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str  # 16 lowercase hex chars
+
+
+def propagation_enabled() -> bool:
+    """Cross-process context propagation, on unless JANUS_TRACE_PROPAGATE
+    is set to 0/false/off."""
+    val = os.environ.get("JANUS_TRACE_PROPAGATE", "1").strip().lower()
+    return val not in ("0", "false", "off", "no")
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    """Render a SpanContext as a W3C traceparent header value."""
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+def parse_traceparent(header: str | None) -> SpanContext | None:
+    """Parse a traceparent header; malformed/absent values yield None so the
+    receiver starts a fresh root trace instead of corrupting span links."""
+    if not header or not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, _flags = m.groups()
+    if version == "ff":  # forbidden by the spec
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id=trace_id, span_id=span_id)
 
 
 @dataclass
@@ -50,13 +92,25 @@ class _Subscriber:
             self._local.spans = []
         return self._local.spans
 
+    def current_context(self) -> SpanContext | None:
+        """SpanContext of the innermost active span on this thread."""
+        path = self._path()
+        if not path:
+            return None
+        return SpanContext(trace_id=self._local.trace_id,
+                           span_id=path[-1][1])
+
     def emit(self, level: str, message: str, **fields) -> None:
         if _LEVELS[level] > self.level:
             return
-        spans = ":".join(e[0] for e in self._path())
+        path = self._path()
+        spans = ":".join(e[0] for e in path)
         if self.cfg.use_json:
             record = {"ts": _time.time(), "level": level, "message": message,
                       "spans": spans, **fields}
+            if path:  # correlate log lines with exported spans
+                record["trace_id"] = self._local.trace_id
+                record["span_id"] = path[-1][1]
             line = _json.dumps(record)
         else:
             extras = " ".join(f"{k}={v}" for k, v in fields.items())
@@ -66,13 +120,21 @@ class _Subscriber:
             print(line, file=self.stream, flush=True)
 
     @contextlib.contextmanager
-    def span(self, name: str, **fields):
+    def span(self, name: str, parent: SpanContext | None = None, **fields):
         path = self._path()
         # one trace id per thread-local root span; spans nest under their
-        # parent's span id so exporters see a single correlated trace
+        # parent's span id so exporters see a single correlated trace.  A
+        # root span may instead resume a remote context (W3C traceparent),
+        # adopting its trace id and parenting under the remote span.
         if not path:
-            self._local.trace_id = os.urandom(16).hex()
-        parent_id = path[-1][1] if path else None
+            if parent is not None and propagation_enabled():
+                self._local.trace_id = parent.trace_id
+                parent_id = parent.span_id
+            else:
+                self._local.trace_id = os.urandom(16).hex()
+                parent_id = None
+        else:
+            parent_id = path[-1][1]
         span_id = os.urandom(8).hex()
         path.append((name, span_id))
         t0 = _time.monotonic()
@@ -115,9 +177,20 @@ def _get() -> _Subscriber:
     return _subscriber
 
 
-def span(name: str, **fields):
-    """Context manager timing a section under the active span path."""
-    return _get().span(name, **fields)
+def span(name: str, parent: SpanContext | None = None, **fields):
+    """Context manager timing a section under the active span path.
+
+    `parent` (a SpanContext, e.g. from parse_traceparent) is honoured only
+    for thread-root spans: the new span resumes the remote trace instead of
+    minting a fresh trace id.
+    """
+    return _get().span(name, parent=parent, **fields)
+
+
+def current_context() -> SpanContext | None:
+    """SpanContext of the innermost active span on the calling thread, or
+    None outside any span."""
+    return _get().current_context()
 
 
 def event(level: str, message: str, **fields) -> None:

@@ -24,7 +24,10 @@ from __future__ import annotations
 import hashlib
 import os
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+except ModuleNotFoundError:  # optional dep: fall back to pure Python
+    from janus_tpu.core.softcrypto import Cipher, algorithms, modes
 
 from janus_tpu.vdaf.field_ref import Field, Field64
 

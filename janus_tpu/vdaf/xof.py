@@ -124,7 +124,14 @@ class XofHmacSha256Aes128:
     def next(self, length: int) -> bytes:
         if self._cipher is None:
             mac = hmac_mod.new(self._seed, bytes(self._message), hashlib.sha256).digest()
-            from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+            try:
+                from cryptography.hazmat.primitives.ciphers import (
+                    Cipher,
+                    algorithms,
+                    modes,
+                )
+            except ModuleNotFoundError:  # fall back to pure Python
+                from janus_tpu.core.softcrypto import Cipher, algorithms, modes
 
             self._cipher = Cipher(
                 algorithms.AES(mac[:16]), modes.CTR(mac[16:32])

@@ -16,7 +16,10 @@ def _open_batch(keys, nonces, aads, cts):
 
 
 def _host_seal(key, nonce, pt, aad):
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ModuleNotFoundError:  # host reference falls back to softcrypto
+        from janus_tpu.core.softcrypto import AESGCM
 
     return AESGCM(bytes(key)).encrypt(bytes(nonce), bytes(pt), bytes(aad))
 

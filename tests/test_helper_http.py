@@ -246,6 +246,45 @@ def test_helper_init_sumvec_device_path():
         server.stop()
 
 
+def test_helper_resumes_leader_trace_over_http():
+    """The helper's handler span joins the leader's trace: same trace id,
+    parented under the leader's HTTP client span (W3C traceparent carried
+    by PeerClient)."""
+    from janus_tpu import trace
+    from janus_tpu.aggregator.http_client import PeerClient
+
+    builder, task, clock, ds, agg, server = _helper_fixture()
+    try:
+        builder.helper_endpoint = server.address
+        leader_task = builder.leader_view()
+        leader = _LeaderOracle(builder, clock)
+        inits = [leader.make_prepare_init(m)[0] for m in (1, 0)]
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector(
+                task.query_type.query_type),
+            prepare_inits=tuple(inits),
+        )
+        captured = []
+        trace.set_span_sink(lambda *a: captured.append(a))
+        try:
+            job_id = AggregationJobId.random()
+            PeerClient().send_to_helper(
+                leader_task, "PUT", f"tasks/{task.task_id}"
+                f"/aggregation_jobs/{job_id}", req.encode(),
+                AggregationJobInitializeReq.MEDIA_TYPE)
+        finally:
+            trace.set_span_sink(None)
+        # sink tuple: (name, t0, t1, fields, trace_id, span_id, parent_id)
+        client = next(c for c in captured if c[0] == "helper request")
+        helper = next(c for c in captured if c[0] == "DAP agg_init")
+        assert helper[4] == client[4]  # ONE trace across both aggregators
+        assert helper[6] == client[5]  # parented under the client span
+        assert client[6] is None       # the client span is the trace root
+    finally:
+        server.stop()
+
+
 def test_helper_continue_step_skew_battery():
     """Step-skew recovery over HTTP (reference
     aggregation_job_continue.rs:597-816): same-step replay with an identical

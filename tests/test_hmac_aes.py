@@ -7,7 +7,11 @@ import hmac as hmac_mod
 
 import numpy as np
 import pytest
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+except ModuleNotFoundError:  # host reference falls back to softcrypto
+    from janus_tpu.core.softcrypto import Cipher, algorithms, modes
 
 from janus_tpu.ops import hmac_aes
 from janus_tpu.vdaf.field_ref import Field64

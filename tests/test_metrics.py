@@ -25,6 +25,54 @@ def test_counter_and_histogram_exposition():
     assert "test_latency_seconds_count 3" in text
 
 
+def test_label_values_are_escaped():
+    """Prometheus text format requires backslash, double-quote, and newline
+    escapes inside label values — a hostile VDAF/task name must not corrupt
+    the whole exposition."""
+    reg = Registry()
+    c = reg.counter("test_escape_total", "escaping")
+    c.add(1, name='has "quotes"')
+    c.add(2, name="back\\slash")
+    c.add(3, name="multi\nline")
+    text = reg.exposition()
+    assert 'name="has \\"quotes\\""} 1' in text
+    assert 'name="back\\\\slash"} 2' in text
+    assert 'name="multi\\nline"} 3' in text
+    # the exposition stays one sample per line despite the raw newline
+    from janus_tpu.metrics import lint_exposition
+
+    assert lint_exposition(text) == []
+
+
+def test_exposition_grammar_lint_smoke():
+    """In-process /metrics output parses cleanly under the text-format
+    grammar lint (CI-safe stand-in for promtool check metrics)."""
+    from janus_tpu import profiler
+    from janus_tpu.metrics import lint_exposition
+
+    # exercise the device-profiler instruments (histograms + gauge) too
+    profiler.record_batch("lint_smoke", "Prio3Count", bucket=128, reports=100,
+                          decode_s=0.01, device_s=0.1, encode_s=0.01,
+                          compile_state="cold")
+    server = HealthServer().start()
+    try:
+        r = requests.get(f"{server.address}/metrics", timeout=5)
+        assert r.status_code == 200
+        errors = lint_exposition(r.text)
+        assert errors == [], errors
+        assert "device_batch_phase_seconds_bucket" in r.text
+        assert "device_padding_waste_ratio" in r.text
+        assert "device_batch_occupancy_bucket" in r.text
+    finally:
+        server.stop()
+
+    # the lint actually rejects malformed expositions
+    assert lint_exposition(
+        "# HELP x h\n# TYPE x counter\nx 1\nstray{] 1\n") != []
+    assert lint_exposition("# TYPE x bogus\nx 1\n") != []
+    assert lint_exposition('# HELP x h\n# TYPE x counter\nx{a="b} 1\n') != []
+
+
 def test_health_server_serves_metrics():
     REGISTRY.counter("test_health_hits", "x").add(1)
     server = HealthServer().start()

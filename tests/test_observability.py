@@ -1,0 +1,127 @@
+"""End-to-end observability: a leader-driven aggregation job step against
+the in-process helper yields ONE correlated trace, feeds the device-engine
+profiler, and leaves a flight-recorder trail — all surfaced at the
+/debug/jobs and /debug/profile console endpoints (ISSUE: end-to-end
+distributed tracing with cross-aggregator propagation)."""
+
+import json
+import urllib.error
+import urllib.request
+
+from test_daemons import _leader_helper_pair
+
+from janus_tpu import flight_recorder, profiler, trace
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.health import HealthServer
+
+
+def _get_json(url: str) -> dict:
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def test_leader_job_step_is_one_trace_and_surfaced():
+    """Acceptance path: run a real leader aggregation-job step over HTTP
+    against the in-process helper, then check all three surfaces."""
+    profiler.clear()
+    flight_recorder.clear()
+    captured = []
+    trace.set_span_sink(lambda *a: captured.append(a))
+    builder, clock, leader_ds, stop = _leader_helper_pair([1, 0, 1])
+    try:
+        driver = AggregationJobDriver(leader_ds,
+                                      batch_aggregation_shard_count=2,
+                                      lease_duration_s=10)
+        leases = driver.acquirer(10)
+        assert len(leases) == 1
+        driver.stepper(leases[0])
+    finally:
+        stop()
+        trace.set_span_sink(None)
+
+    # -- one trace: every helper-side handler span resumes the trace of the
+    # leader-side HTTP client span that carried it, parented under it.
+    # sink tuple: (name, t0, t1, fields, trace_id, span_id, parent_id)
+    clients = [c for c in captured if c[0] == "helper request"]
+    helpers = [c for c in captured
+               if c[0] in ("DAP agg_init", "DAP agg_cont")]
+    assert clients and helpers
+    by_span_id = {c[5]: c for c in clients}
+    for h in helpers:
+        client = by_span_id.get(h[6])
+        assert client is not None, f"helper span has no client parent: {h}"
+        assert h[4] == client[4], "helper span minted its own trace id"
+
+    # -- profiler: at least one device (or host-fallback) batch with the
+    # full phase split and occupancy.
+    batches = profiler.snapshot()
+    assert batches
+    rec = batches[0]
+    assert {"decode_s", "device_s", "encode_s"} <= set(rec["phases"])
+    assert 0.0 < rec["occupancy"] <= 1.0
+    assert rec["compile"] in ("cold", "warm")
+    assert rec["reports"] >= 1
+
+    # -- flight recorder: the job left an acquired->stepped trail.
+    events = flight_recorder.snapshot()
+    kinds = [e["event"] for e in events]
+    assert "acquired" in kinds and "stepped" in kinds
+    stepped = next(e for e in events if e["event"] == "stepped")
+    assert stepped["task_id"] == str(builder.task_id)
+
+    # -- console surfacing of both rings.
+    srv = HealthServer(debug_console=True).start()
+    try:
+        jobs = _get_json(srv.address + "/debug/jobs")
+        assert jobs["capacity"] >= 1
+        assert jobs["count"] == len(jobs["events"])
+        assert any(e["event"] == "acquired" for e in jobs["events"])
+        seqs = [e["seq"] for e in jobs["events"]]
+        assert seqs == sorted(seqs)
+
+        filtered = _get_json(
+            srv.address + f"/debug/jobs?job_id={stepped['job_id']}&limit=2")
+        assert 1 <= filtered["count"] <= 2
+        assert all(e["job_id"] == stepped["job_id"]
+                   for e in filtered["events"])
+
+        prof = _get_json(srv.address + "/debug/profile")
+        assert prof["batches"]
+        first = prof["batches"][0]
+        assert {"decode_s", "device_s", "encode_s"} <= set(first["phases"])
+        assert "occupancy" in first and "compile" in first
+        assert prof["summary"]  # cumulative per-kind padding waste
+        for stats in prof["summary"].values():
+            assert {"padded_lanes", "total_lanes",
+                    "waste_ratio"} <= set(stats)
+    finally:
+        srv.stop()
+
+
+def test_debug_endpoints_gated_behind_console_flag():
+    srv = HealthServer(debug_console=False).start()
+    try:
+        for path in ("/debug/jobs", "/debug/profile", "/debug/state"):
+            try:
+                urllib.request.urlopen(srv.address + path)
+                raise AssertionError(f"{path} served with console disabled")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_flight_recorder_ring_bounds_and_filter():
+    rec = flight_recorder.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("stepped", job_id=f"j{i % 2}", step=i)
+    events = rec.snapshot()
+    assert len(events) == 4  # bounded ring keeps only the tail
+    assert [e["step"] for e in events] == [6, 7, 8, 9]
+    only_j1 = rec.snapshot(job_id="j1")
+    assert all(e["job_id"] == "j1" for e in only_j1)
+    assert rec.snapshot(limit=2) == events[-2:]
+    # recording is failure-proof: unserializable fields are stringified,
+    # and record() never raises
+    rec.record("weird", job_id=object(), blob=object())
+    assert rec.snapshot()[-1]["event"] == "weird"

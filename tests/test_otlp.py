@@ -73,6 +73,33 @@ def test_metric_and_span_export():
         srv.shutdown()
 
 
+def test_role_resource_attribute_and_gauge_export():
+    """OtlpConfig(role=...) lands on the OTLP resource so a shared collector
+    can split leader from helper; Gauge instruments export as gauges."""
+    _Capture.received = []
+    srv, endpoint = _server()
+    try:
+        g = metrics.REGISTRY.gauge("janus_otlp_test_gauge", "test")
+        g.set(0.25, kind="z")
+        exp = OtlpExporter(OtlpConfig(
+            endpoint=endpoint, interval_s=3600, role="helper",
+            resource_attributes={"deployment": "test"}))
+        exp.flush()
+        mpayload = next(b for p, b in _Capture.received if p == "/v1/metrics")
+        rm = mpayload["resourceMetrics"][0]
+        attrs = rm["resource"]["attributes"]
+        assert {"key": "role", "value": {"stringValue": "helper"}} in attrs
+        assert {"key": "deployment",
+                "value": {"stringValue": "test"}} in attrs
+        gm = next(m for sm in rm["scopeMetrics"] for m in sm["metrics"]
+                  if m["name"] == "janus_otlp_test_gauge")
+        pt = gm["gauge"]["dataPoints"][0]
+        assert pt["asDouble"] == 0.25
+        assert {"key": "kind", "value": {"stringValue": "z"}} in pt["attributes"]
+    finally:
+        srv.shutdown()
+
+
 def test_export_failure_is_swallowed():
     exp = OtlpExporter(OtlpConfig(endpoint="http://127.0.0.1:9",  # closed
                                   interval_s=3600))

@@ -1,0 +1,98 @@
+"""Known-answer anchors for the pure-Python crypto fallback
+(janus_tpu.core.softcrypto) that stands in for the `cryptography` package
+when the wheel is absent: FIPS-197 AES, NIST GCM, RFC 8439
+ChaCha20Poly1305, RFC 7748 X25519, P-256 ECDH agreement, CTR streaming."""
+
+import pytest
+
+from janus_tpu.core import softcrypto as sc
+
+
+def test_aes128_fips197_block():
+    key = bytes(range(16))
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    enc = sc.Cipher(sc.algorithms.AES(key), sc.modes.ECB()).encryptor()
+    ct = enc.update(pt) + enc.finalize()
+    assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"  # FIPS-197 C.1
+
+
+def test_aes_gcm_nist_vectors():
+    # NIST GCM test case 1: empty plaintext/aad -> pure tag
+    out = sc.AESGCM(bytes(16)).encrypt(bytes(12), b"", None)
+    assert out.hex() == "58e2fccefa7e3061367f1d57a4e7455a"
+    # NIST GCM test case 4: 60-byte plaintext with aad
+    key = bytes.fromhex("feffe9928665731c6d6a8f9467308308")
+    iv = bytes.fromhex("cafebabefacedbaddecaf888")
+    pt = bytes.fromhex(
+        "d9313225f88406e5a55909c5aff5269a86a7a9531534f7da2e4c303d8a318a72"
+        "1c3c0c95956809532fcf0e2449a6b525b16aedf5aa0de657ba637b39")
+    aad = bytes.fromhex("feedfacedeadbeeffeedfacedeadbeefabaddad2")
+    out = sc.AESGCM(key).encrypt(iv, pt, aad)
+    assert out[-16:].hex() == "5bc94fbc3221a5db94fae95ae7121a47"
+    assert sc.AESGCM(key).decrypt(iv, out, aad) == pt
+    # tampering with the tag must raise, not return garbage
+    with pytest.raises(sc.InvalidTag):
+        sc.AESGCM(key).decrypt(iv, out[:-1] + bytes([out[-1] ^ 1]), aad)
+
+
+def test_chacha20poly1305_rfc8439():
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    pt = (b"Ladies and Gentlemen of the class of '99: If I could offer you "
+          b"only one tip for the future, sunscreen would be it.")
+    out = sc.ChaCha20Poly1305(key).encrypt(nonce, pt, aad)
+    assert out[-16:].hex() == "1ae10b594f09e26a7e902ecbd0600691"
+    assert sc.ChaCha20Poly1305(key).decrypt(nonce, out, aad) == pt
+
+
+def test_x25519_rfc7748_and_dh_symmetry():
+    k = bytes.fromhex("a546e36bf0527c9d3b16154b82465edd"
+                      "62144c0ac1fc5a18506a2244ba449ac4")
+    u = bytes.fromhex("e6db6867583030db3594c1a424b15f7c"
+                      "726624ec26b3353b10a903a6d0ab1c4c")
+    priv = sc.X25519PrivateKey.from_private_bytes(k)
+    shared = priv.exchange(sc.X25519PublicKey.from_public_bytes(u))
+    assert shared.hex() == ("c3da55379de9c6908e94ea4df28d084f"
+                            "32eccf03491c71f754b4075577a28552")
+    a, b = sc.X25519PrivateKey.generate(), sc.X25519PrivateKey.generate()
+    assert a.exchange(b.public_key()) == b.exchange(a.public_key())
+
+
+def test_p256_ecdh_symmetry_and_point_validation():
+    a = sc.ec.generate_private_key(sc.ec.SECP256R1())
+    b = sc.ec.generate_private_key(sc.ec.SECP256R1())
+    a_pub = a.public_key().public_bytes(
+        sc.serialization.Encoding.X962,
+        sc.serialization.PublicFormat.UncompressedPoint)
+    b_pub = b.public_key().public_bytes(
+        sc.serialization.Encoding.X962,
+        sc.serialization.PublicFormat.UncompressedPoint)
+    assert len(a_pub) == 65 and a_pub[0] == 4
+    sa = a.exchange(sc.ec.ECDH(), sc.ec.EllipticCurvePublicKey
+                    .from_encoded_point(sc.ec.SECP256R1(), b_pub))
+    sb = b.exchange(sc.ec.ECDH(), sc.ec.EllipticCurvePublicKey
+                    .from_encoded_point(sc.ec.SECP256R1(), a_pub))
+    assert sa == sb
+    # off-curve points must be rejected at decode time
+    bad = bytearray(a_pub)
+    bad[-1] ^= 1
+    with pytest.raises(ValueError):
+        sc.ec.EllipticCurvePublicKey.from_encoded_point(
+            sc.ec.SECP256R1(), bytes(bad))
+
+
+def test_aes_ctr_streaming_matches_one_shot():
+    key, iv = bytes(range(16)), bytes(range(100, 116))
+    data = bytes(range(256)) * 3
+    one = sc.Cipher(sc.algorithms.AES(key), sc.modes.CTR(iv)).encryptor()
+    whole = one.update(data) + one.finalize()
+    chunked = sc.Cipher(sc.algorithms.AES(key), sc.modes.CTR(iv)).encryptor()
+    parts, i = [], 0
+    for size in (1, 7, 16, 33, 100, 9999):  # straddles block boundaries
+        parts.append(chunked.update(data[i:i + size]))
+        i += size
+    assert b"".join(parts) + chunked.finalize() == whole
+    # CTR is an involution
+    dec = sc.Cipher(sc.algorithms.AES(key), sc.modes.CTR(iv)).encryptor()
+    assert dec.update(whole) == data

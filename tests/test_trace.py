@@ -2,7 +2,9 @@
 
 import io
 import json
+import re
 
+from janus_tpu import trace
 from janus_tpu.trace import TraceConfiguration, install_trace_subscriber
 
 
@@ -19,6 +21,69 @@ def test_span_nesting_and_json_output():
     assert lines[0]["duration_ms"] >= 0
     assert lines[1]["spans"] == "outer"
     install_trace_subscriber()  # reset process-global default
+
+
+def test_traceparent_inject_extract_round_trip():
+    """Client injects its context; the far side resumes the SAME trace with
+    the client span as parent — the cross-aggregator propagation contract."""
+    captured = []
+    trace.set_span_sink(lambda *a: captured.append(a))
+    try:
+        with trace.span("client"):
+            ctx = trace.current_context()
+            header = trace.format_traceparent(ctx)
+        remote = trace.parse_traceparent(header)
+        assert remote == ctx
+        with trace.span("server", parent=remote):
+            resumed = trace.current_context()
+            assert resumed.trace_id == ctx.trace_id
+            assert resumed.span_id != ctx.span_id
+    finally:
+        trace.set_span_sink(None)
+    server = next(c for c in captured if c[0] == "server")
+    assert server[4] == ctx.trace_id  # resumed, not re-minted
+    assert server[6] == ctx.span_id   # parented under the remote span
+
+
+def test_malformed_traceparent_yields_fresh_root():
+    bad_headers = (
+        None, "", "garbage",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "a" * 32 + "-" + "1" * 16 + "-01",  # forbidden version
+        "00-" + "a" * 31 + "-" + "1" * 16 + "-01",  # short trace id
+        "00-" + "a" * 32 + "-" + "1" * 16,          # missing flags
+    )
+    for bad in bad_headers:
+        assert trace.parse_traceparent(bad) is None, bad
+    # a None parent (malformed header upstream) starts a fresh root trace
+    with trace.span("server", parent=trace.parse_traceparent("garbage")):
+        ctx = trace.current_context()
+        assert ctx is not None and re.fullmatch(r"[0-9a-f]{32}", ctx.trace_id)
+
+
+def test_propagation_disable_env(monkeypatch):
+    monkeypatch.setenv("JANUS_TRACE_PROPAGATE", "0")
+    remote = trace.SpanContext("ab" * 16, "cd" * 8)
+    with trace.span("server", parent=remote):
+        ctx = trace.current_context()
+        assert ctx.trace_id != remote.trace_id  # knob severs the link
+
+
+def test_json_log_records_carry_trace_ids():
+    buf = io.StringIO()
+    sub = install_trace_subscriber(TraceConfiguration(
+        level="debug", use_json=True, stream=buf))
+    with sub.span("outer"):
+        sub.emit("info", "inside")
+    sub.emit("info", "outside")
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    inside = next(l for l in lines if l["message"] == "inside")
+    assert re.fullmatch(r"[0-9a-f]{32}", inside["trace_id"])
+    assert re.fullmatch(r"[0-9a-f]{16}", inside["span_id"])
+    outside = next(l for l in lines if l["message"] == "outside")
+    assert "trace_id" not in outside  # no active span, no fake correlation
+    install_trace_subscriber()
 
 
 def test_level_filtering():
