@@ -11,3 +11,6 @@ from janus_tpu.aggregator.http_handlers import (  # noqa: F401
     DapHttpServer,
     DapRouter,
 )
+from janus_tpu.aggregator.upload_pipeline import (  # noqa: F401
+    UploadPipeline,
+)

@@ -92,6 +92,17 @@ class AggregatorConfig:
     # (engine/fused_init.py).  Below this the coalescer's cross-job packing
     # amortizes the device link round trip better than per-job launches.
     fused_init_min_lanes: int = 4096
+    # Upload validation pipeline (aggregator/upload_pipeline.py): coalesce
+    # concurrent handle_upload calls into batched HPKE opens + vectorized
+    # validation.  Window/batch mirror CoalescingEngine's knobs; a lone
+    # upload pays at most one collection window of extra latency.
+    upload_coalesce_enabled: bool = True
+    upload_coalesce_max_batch: int = 4096
+    upload_coalesce_window_ms: float = 4.0
+    # Lane count at or above which the coalesced open prefers the device
+    # HPKE kernel; None defers to the hpke auto policy
+    # (JANUS_TPU_DEVICE_HPKE / JANUS_TPU_DEVICE_HPKE_MIN).
+    upload_device_open_min: int | None = None
 
 
 class TaskAggregator:
@@ -195,12 +206,27 @@ class Aggregator:
         # (fetched_at, value) TTL caches; guarded by _task_lock (cheap,
         # uncontended - the hit path holds it for a dict lookup).
         self._global_hpke: tuple[float, list] | None = None
+        # Single-flight gate for the global-keypair refresh: a cache-expiry
+        # burst under upload load must issue ONE datastore read, with every
+        # concurrent caller served from the winner's result (reference
+        # GlobalHpkeKeypairCache's background refresher, cache.rs:24).
+        self._global_hpke_fetch = threading.Lock()
         self._peers: dict[tuple[str, Role], tuple[float, object]] = {}
         self.report_writer = ReportWriteBatcher(
             datastore,
             max_batch_size=self.cfg.max_upload_batch_size,
             max_batch_write_delay_ms=self.cfg.max_upload_batch_write_delay_ms,
         )
+        from janus_tpu.aggregator.upload_pipeline import UploadPipeline
+
+        self.upload_pipeline = (
+            UploadPipeline(
+                self,
+                max_batch=self.cfg.upload_coalesce_max_batch,
+                max_delay_ms=self.cfg.upload_coalesce_window_ms,
+                device_min_batch=self.cfg.upload_device_open_min,
+            )
+            if self.cfg.upload_coalesce_enabled else None)
 
     # -- task cache (reference aggregator.rs:662) -------------------------
 
@@ -237,15 +263,24 @@ class Aggregator:
             hit = self._global_hpke
             if hit is not None and now - hit[0] < self.cfg.global_hpke_cache_ttl_s:
                 return hit[1]
-        keypairs = self.datastore.run_tx(
-            "get_global_hpke", lambda tx: tx.get_global_hpke_keypairs())
-        # Never cache an EMPTY result: freshly provisioned keys must take
-        # effect on the next request, as they did pre-cache (a cached miss
-        # would reject valid traffic for a whole TTL).
-        if keypairs:
+        # Single-flight the refresh: the first caller through the gate does
+        # the datastore read; everyone else re-checks the cache it filled.
+        with self._global_hpke_fetch:
+            now = _time.monotonic()
             with self._task_lock:
-                self._global_hpke = (now, keypairs)
-        return keypairs
+                hit = self._global_hpke
+                if (hit is not None
+                        and now - hit[0] < self.cfg.global_hpke_cache_ttl_s):
+                    return hit[1]
+            keypairs = self.datastore.run_tx(
+                "get_global_hpke", lambda tx: tx.get_global_hpke_keypairs())
+            # Never cache an EMPTY result: freshly provisioned keys must
+            # take effect on the next request, as they did pre-cache (a
+            # cached miss would reject valid traffic for a whole TTL).
+            if keypairs:
+                with self._task_lock:
+                    self._global_hpke = (now, keypairs)
+            return keypairs
 
     def _taskprov_peer_cached(self, endpoint: str, role: Role):
         now = _time.monotonic()
@@ -320,6 +355,23 @@ class Aggregator:
             report = Report.decode(body)
         except Exception as e:
             raise err.InvalidMessage(f"malformed report: {e}", task_id) from e
+        if self.upload_pipeline is not None:
+            # Hot path: coalesced batch validation (upload_pipeline.py).
+            # Raises err.ReportRejected with the identical rejection the
+            # sync path below would produce.
+            self.upload_pipeline.submit(ta, report)
+            return
+        self._validate_upload_sync(ta, report)
+
+    def _validate_upload_sync(self, ta: TaskAggregator,
+                              report: Report) -> None:
+        """Per-report upload validation: the readable spec for the
+        coalesced pipeline's rejection semantics, the fallback when the
+        pipeline is disabled, and the benchmark baseline.  Keep this and
+        UploadPipeline._process in lockstep (tests/test_upload_pipeline.py
+        asserts byte-identical verdicts)."""
+        task = ta.task
+        task_id = task.task_id
 
         def reject(reason: err.ReportRejectionReason):
             rejection = err.ReportRejection(
@@ -386,6 +438,14 @@ class Aggregator:
                     and gk.state is m.HpkeKeyState.ACTIVE):
                 return gk.keypair
         return None
+
+    def shutdown(self) -> None:
+        """Drain in-flight upload state: queued pipeline entries resolve,
+        then buffered writes/rejections hit the datastore.  Called by
+        DapHttpServer.stop() so a drained server loses nothing."""
+        if self.upload_pipeline is not None:
+            self.upload_pipeline.drain()
+        self.report_writer.flush()
 
     # -- taskprov opt-in (reference aggregator.rs:709) --------------------
 

@@ -303,6 +303,10 @@ class DapHttpServer:
                 self._run("OPTIONS")
 
         self.server = ThreadingHTTPServer((host, port), Handler)
+        # Upload bursts fan one thread per connection; daemonize them so a
+        # server stop never blocks on a handler parked in the upload
+        # coalescer's collection window.
+        self.server.daemon_threads = True
         self._thread: threading.Thread | None = None
 
     @property
@@ -321,3 +325,7 @@ class DapHttpServer:
         self.server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        # Reports accepted but still buffered (pipeline queue, write
+        # batcher delay window) must reach the datastore before the
+        # process goes away — a drained server loses nothing.
+        self.router.aggregator.shutdown()

@@ -8,6 +8,13 @@ sharded task_upload_counters rows (reference report_writer.rs:326).
 
 Duplicate uploads conflict inside the flush transaction; conflicting
 duplicates are rejected per report without failing the rest of the batch.
+
+Concurrency discipline: the buffers are drained under the SAME lock that
+observed the threshold crossing (`_append` / `_drain_locked`), so two
+threads hitting `max_batch_size` simultaneously each write exactly what
+they drained — a concurrent flush of an already-drained buffer is a no-op
+(no empty-transaction round trip) and the delay timer is cancelled exactly
+once, by whichever drainer takes it.
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ from __future__ import annotations
 import random
 import threading
 
+from janus_tpu import metrics
 from janus_tpu.datastore import models as m
 from janus_tpu.datastore.datastore import Datastore, MutationTargetAlreadyExists
 
@@ -35,42 +43,64 @@ class ReportWriteBatcher:
     # -- public API --------------------------------------------------------
 
     def write_report(self, task, logic, report: m.LeaderStoredReport) -> None:
-        with self._lock:
-            self._buffer.append((task, logic, report))
-            should_flush = (len(self._buffer) + len(self._rejections)
-                            >= self.max_batch_size)
-            if not should_flush and self._timer is None:
-                self._timer = threading.Timer(self.max_batch_write_delay,
-                                              self.flush)
-                self._timer.daemon = True
-                self._timer.start()
-        if should_flush:
-            self.flush()
+        self._append(((task, logic, report),), ())
 
     def write_rejection(self, rejection) -> None:
-        with self._lock:
-            self._rejections.append(rejection)
-            should_flush = (len(self._buffer) + len(self._rejections)
-                            >= self.max_batch_size)
-            if not should_flush and self._timer is None:
-                self._timer = threading.Timer(self.max_batch_write_delay,
-                                              self.flush)
-                self._timer.daemon = True
-                self._timer.start()
-        if should_flush:
-            self.flush()
+        self._append((), (rejection,))
+
+    def write_upload_batch(self, reports, rejections) -> None:
+        """Bulk handoff from the upload pipeline: one append and at most
+        one flush for a whole validated batch, preserving arrival order
+        (order decides which duplicate report-id wins in the transaction).
+
+        `reports`: iterable of (task, logic, LeaderStoredReport);
+        `rejections`: iterable of ReportRejection.
+        """
+        self._append(tuple(reports), tuple(rejections))
 
     def flush(self) -> None:
         """Write everything buffered in one transaction."""
         with self._lock:
-            if self._timer is not None:
-                self._timer.cancel()
-                self._timer = None
-            buffer, self._buffer = self._buffer, []
-            rejections, self._rejections = self._rejections, []
-        if not buffer and not rejections:
-            return
+            drained = self._drain_locked()
+        if drained[0] or drained[1]:
+            self._write(*drained)
 
+    # -- machinery ---------------------------------------------------------
+
+    def _append(self, reports: tuple, rejections: tuple) -> None:
+        from janus_tpu.aggregator.error import ReportRejectionReason
+
+        for rejection in rejections:
+            if rejection.reason is ReportRejectionReason.DECRYPT_FAILURE:
+                metrics.upload_decrypt_failure_counter.add(1)
+            elif rejection.reason is ReportRejectionReason.DECODE_FAILURE:
+                metrics.upload_decode_failure_counter.add(1)
+        drained = None
+        with self._lock:
+            self._buffer.extend(reports)
+            self._rejections.extend(rejections)
+            if (len(self._buffer) + len(self._rejections)
+                    >= self.max_batch_size):
+                drained = self._drain_locked()
+            elif self._timer is None and (self._buffer or self._rejections):
+                self._timer = threading.Timer(self.max_batch_write_delay,
+                                              self.flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if drained is not None:
+            self._write(*drained)
+
+    def _drain_locked(self) -> tuple[list, list]:
+        """Take ownership of the buffered work.  Caller holds _lock; the
+        drainer also owns cancelling the pending timer (exactly once)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        buffer, self._buffer = self._buffer, []
+        rejections, self._rejections = self._rejections, []
+        return buffer, rejections
+
+    def _write(self, buffer: list, rejections: list) -> None:
         from janus_tpu.aggregator.error import ReportRejectionReason
 
         def txn(tx):

@@ -293,7 +293,8 @@ def _device_hpke_auto(n: int) -> bool:
 def open_ciphertexts_batch(keypair: "HpkeKeypair", application_info: bytes,
                            ciphertexts: list[HpkeCiphertext],
                            aads: list[bytes],
-                           prefer_device: bool | None = None
+                           prefer_device: bool | None = None,
+                           stats: dict | None = None
                            ) -> list[bytes | None]:
     """Open many ciphertexts under one keypair/info.  Three engines, best
     first: the TPU kernel for the DAP-default suite (ops/hpke_device.py —
@@ -301,21 +302,79 @@ def open_ciphertexts_batch(keypair: "HpkeKeypair", application_info: bytes,
     the GIL-free native pass (native/hpke_open.cpp), then the per-report
     Python path.  Per-lane results: plaintext or None (failed) — a failed
     lane never aborts the batch (the caller maps None to
-    PrepareError::HpkeDecryptError, reference aggregator.rs:1800)."""
+    PrepareError::HpkeDecryptError, reference aggregator.rs:1800).
+
+    `stats`, when given, receives {"backend": "device"|"native"|"python"}
+    for the engine that handled the batch (observability only)."""
     if len(ciphertexts) != len(aads):
         raise ValueError(
             f"ciphertexts/aads length mismatch: {len(ciphertexts)} != {len(aads)}")
     return open_ciphertexts_batch_raw(
         keypair, application_info,
         [ct.encapsulated_key for ct in ciphertexts],
-        [ct.payload for ct in ciphertexts], aads, prefer_device)
+        [ct.payload for ct in ciphertexts], aads, prefer_device, stats)
+
+
+def open_ciphertexts_grouped(lanes, application_info: bytes,
+                             prefer_device: bool | None = None,
+                             stats: dict | None = None
+                             ) -> list[bytes | None]:
+    """Open lanes held under DIFFERENT keypairs: one batched open per
+    keypair group (the upload path mixes per-task and global keys in one
+    coalesced batch; the helper-init path resolves several config ids per
+    request).
+
+    `lanes`: sequence of (keypair, HpkeCiphertext, aad) triples.  Returns
+    [plaintext | None] aligned with `lanes`.  Lanes a multi-lane batch
+    engine fails are retried individually through the per-report path —
+    the per-lane verdict must be authoritative (an upload rejection is
+    user-visible), never an artifact of batch staging.
+
+    `stats`, when given, accumulates {"groups", "backends", "stragglers",
+    "straggler_recovered"}.
+    """
+    out: list[bytes | None] = [None] * len(lanes)
+    groups: dict[int, tuple] = {}  # id(keypair) -> (keypair, [lane index])
+    for i, (keypair, _ct, _aad) in enumerate(lanes):
+        entry = groups.get(id(keypair))
+        if entry is None:
+            groups[id(keypair)] = (keypair, [i])
+        else:
+            entry[1].append(i)
+    backends: set[str] = set()
+    stragglers = recovered = 0
+    for keypair, idxs in groups.values():
+        group_stats: dict = {}
+        opened = open_ciphertexts_batch(
+            keypair, application_info,
+            [lanes[i][1] for i in idxs], [lanes[i][2] for i in idxs],
+            prefer_device, group_stats)
+        if "backend" in group_stats:
+            backends.add(group_stats["backend"])
+        for i, pt in zip(idxs, opened):
+            if pt is None and len(idxs) > 1:
+                stragglers += 1
+                try:
+                    pt = open_ciphertext(keypair, application_info,
+                                         lanes[i][1], lanes[i][2])
+                    recovered += 1
+                except HpkeError:
+                    pt = None
+            out[i] = pt
+    if stats is not None:
+        stats["groups"] = len(groups)
+        stats["backends"] = sorted(backends)
+        stats["stragglers"] = stragglers
+        stats["straggler_recovered"] = recovered
+    return out
 
 
 def open_ciphertexts_batch_raw(keypair: "HpkeKeypair",
                                application_info: bytes,
                                encs: list[bytes], payloads: list[bytes],
                                aads: list[bytes],
-                               prefer_device: bool | None = None
+                               prefer_device: bool | None = None,
+                               stats: dict | None = None
                                ) -> list[bytes | None]:
     """open_ciphertexts_batch on raw wire components — the columnar
     aggregate-init path calls this without building HpkeCiphertext
@@ -335,8 +394,11 @@ def open_ciphertexts_batch_raw(keypair: "HpkeKeypair",
     if (device_ok and prefer_device and len(encs) > 1
             and not _device_disabled()):
         try:
-            return _open_batch_hybrid(keypair, application_info, encs,
-                                      payloads, aads)
+            res = _open_batch_hybrid(keypair, application_info, encs,
+                                     payloads, aads)
+            if stats is not None:
+                stats["backend"] = "device"
+            return res
         except Exception:
             # the native/Python paths still work; latch the device path off
             # after repeated failures so a broken kernel doesn't tax every
@@ -359,7 +421,11 @@ def open_ciphertexts_batch_raw(keypair: "HpkeKeypair",
             keypair.private_key, config.public_key.data,
             config.aead_id.code, application_info, encs, payloads, aads)
         if res is not None:
+            if stats is not None:
+                stats["backend"] = "native"
             return res
+    if stats is not None:
+        stats["backend"] = "python"
     out: list[bytes | None] = []
     for enc, payload, aad in zip(encs, payloads, aads):
         try:

@@ -92,6 +92,21 @@ class MutationTargetNotFound(DatastoreError):
 # ---------------------------------------------------------------------------
 
 
+def _best_aesgcm(key: bytes):
+    """Fastest AES-GCM at hand: pyca `cryptography` when installed, else
+    the native libcrypto one-shot (janus_tpu.native.AesGcm), else the
+    pure-Python softcrypto fallback.  All three interoperate (same wire
+    format), so rows written by one decrypt under another — the choice is
+    purely a throughput matter: softcrypto costs ~1 ms per column write,
+    which dominates the bulk upload-flush transaction."""
+    if not AESGCM.__module__.startswith("janus_tpu"):
+        return AESGCM(key)  # pyca cryptography
+    from janus_tpu import native
+    if native.aead_available():
+        return native.AesGcm(key)
+    return AESGCM(key)
+
+
 class Crypter:
     """AES-128-GCM column encryption with key rotation
     (reference datastore.rs:5133): first key encrypts, all keys decrypt."""
@@ -101,7 +116,7 @@ class Crypter:
 
     def __init__(self, keys: list[bytes]):
         assert keys and all(len(k) == self.KEY_SIZE for k in keys)
-        self._aeads = [AESGCM(k) for k in keys]
+        self._aeads = [_best_aesgcm(k) for k in keys]
 
     @classmethod
     def generate(cls) -> "Crypter":

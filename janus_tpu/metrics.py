@@ -232,6 +232,28 @@ device_padding_waste_ratio = REGISTRY.gauge(
 device_batch_compiles = REGISTRY.counter(
     "janus_device_batch_compiles",
     "device batches that paid a cold kernel compile, by kind/bucket")
+# upload-pipeline instruments (aggregator/upload_pipeline.py): how well the
+# coalescer turns concurrent handle_upload calls into batched opens
+upload_batch_size = REGISTRY.histogram(
+    "janus_upload_batch_size",
+    "reports per coalesced upload validation batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+upload_queue_delay = REGISTRY.histogram(
+    "janus_upload_queue_delay_seconds",
+    "time an upload waited in the coalescer before its batch was drained",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0))
+upload_phase_seconds = REGISTRY.histogram(
+    "janus_upload_phase_seconds",
+    "upload batch phase latency by phase (validate/open/decode/write)")
+upload_batched_reports = REGISTRY.counter(
+    "janus_upload_batched_reports",
+    "reports validated through the coalesced upload pipeline, by HPKE open "
+    "backend (device/native/python/none)")
+upload_open_stragglers = REGISTRY.counter(
+    "janus_upload_open_stragglers",
+    "upload lanes a batched HPKE open failed and the per-report path "
+    "retried, by outcome (recovered/failed)")
 
 
 def all_instruments() -> list:

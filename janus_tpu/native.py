@@ -255,6 +255,16 @@ def _load_hpke():
                 ctypes.c_int, ctypes.c_char_p, ctypes.c_long,
                 ctypes.c_char_p, ctypes.c_char_p, i64p, ctypes.c_char_p,
                 i64p, u8p, i64p, u8p]
+            lib.aead_seal_one.restype = ctypes.c_int
+            lib.aead_seal_one.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+                ctypes.c_long, u8p]
+            lib.aead_open_one.restype = ctypes.c_long
+            lib.aead_open_one.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+                ctypes.c_long, u8p]
             _hpke_lib = lib
         except OSError:
             _hpke_lib = None
@@ -310,6 +320,64 @@ def hpke_open_batch(sk_r: bytes, pk_r: bytes, aead_id: int, info: bytes,
         blob[out_offs[i]:out_offs[i + 1]] if status[i] else None
         for i in range(n)
     ]
+
+
+def aead_available() -> bool:
+    """True when the native one-shot AEAD (aead_seal_one/aead_open_one in
+    native/hpke_open.cpp) is loadable."""
+    lib = _load_hpke()
+    return lib is not None and hasattr(lib, "aead_seal_one")
+
+
+class AesGcm:
+    """AES-GCM over libcrypto, mirroring the `cryptography` AESGCM API
+    (`encrypt(nonce, data, aad)` -> ct||tag).  The datastore Crypter uses
+    this when the `cryptography` package is absent: the pure-Python
+    softcrypto fallback costs ~1 ms per column write, which dominates the
+    bulk upload-flush transaction (see aggregator/upload_pipeline.py)."""
+
+    def __init__(self, key: bytes):
+        if len(key) == 16:
+            self._aead_id = 1
+        elif len(key) == 32:
+            self._aead_id = 2
+        else:
+            raise ValueError("AES-GCM key must be 16 or 32 bytes")
+        self._key = bytes(key)
+        self._lib = _load_hpke()
+        if self._lib is None or not hasattr(self._lib, "aead_seal_one"):
+            raise RuntimeError("native AEAD unavailable (gate on "
+                               "aead_available())")
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        aad = bytes(aad) if aad else b""
+        data = bytes(data)
+        out = np.empty(len(data) + 16, dtype=np.uint8)
+        ok = self._lib.aead_seal_one(
+            self._aead_id, self._key, bytes(nonce), aad, len(aad),
+            data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if ok != 1:
+            raise ValueError("AEAD seal failed")
+        return out.tobytes()
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        from janus_tpu.core.softcrypto import InvalidTag
+
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        if len(data) < 16:
+            raise InvalidTag("ciphertext shorter than the tag")
+        aad = bytes(aad) if aad else b""
+        data = bytes(data)
+        out = np.empty(max(1, len(data) - 16), dtype=np.uint8)
+        n = self._lib.aead_open_one(
+            self._aead_id, self._key, bytes(nonce), aad, len(aad),
+            data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if n < 0:
+            raise InvalidTag("AEAD open failed")
+        return out.tobytes()[:int(n)]
 
 
 def checksum_report_ids(ids: bytes, seed: bytes = bytes(32)):

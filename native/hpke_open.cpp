@@ -57,11 +57,18 @@ int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX* ctx, int type, int arg, void* ptr);
 int EVP_DecryptUpdate(EVP_CIPHER_CTX* ctx, unsigned char* out, int* outl,
                       const unsigned char* in, int inl);
 int EVP_DecryptFinal_ex(EVP_CIPHER_CTX* ctx, unsigned char* outm, int* outl);
+int EVP_EncryptInit_ex(EVP_CIPHER_CTX* ctx, const EVP_CIPHER* cipher,
+                       ENGINE* impl, const unsigned char* key,
+                       const unsigned char* iv);
+int EVP_EncryptUpdate(EVP_CIPHER_CTX* ctx, unsigned char* out, int* outl,
+                      const unsigned char* in, int inl);
+int EVP_EncryptFinal_ex(EVP_CIPHER_CTX* ctx, unsigned char* out, int* outl);
 }  // extern "C" (libcrypto declarations)
 
 // OpenSSL public constants (stable across 1.1/3.x)
 static const int EVP_PKEY_X25519_ID = 1034;        // NID_X25519
 static const int EVP_CTRL_AEAD_SET_IVLEN_ID = 0x9;
+static const int EVP_CTRL_AEAD_GET_TAG_ID = 0x10;
 static const int EVP_CTRL_AEAD_SET_TAG_ID = 0x11;
 
 extern "C" {
@@ -173,6 +180,52 @@ static bool aead_open(int aead_id, const uint8_t* key, const uint8_t* nonce,
     }
     if (ctx) EVP_CIPHER_CTX_free(ctx);
     return ok;
+}
+
+static const EVP_CIPHER* cipher_for(int aead_id) {
+    return aead_id == 1 ? EVP_aes_128_gcm()
+         : aead_id == 2 ? EVP_aes_256_gcm()
+         : aead_id == 3 ? EVP_chacha20_poly1305()
+                        : nullptr;
+}
+
+// Single-shot AEAD seal (datastore column encryption: Crypter).  `out`
+// needs capacity pt_len + 16; writes ct || tag.  Returns 1 on success.
+int aead_seal_one(int aead_id, const uint8_t* key, const uint8_t* nonce,
+                  const uint8_t* aad, long aad_len, const uint8_t* pt,
+                  long pt_len, uint8_t* out) {
+    const EVP_CIPHER* cipher = cipher_for(aead_id);
+    if (!cipher || pt_len < 0 || aad_len < 0) return 0;
+    bool ok = false;
+    EVP_CIPHER_CTX* ctx = EVP_CIPHER_CTX_new();
+    int len = 0;
+    if (ctx
+        && EVP_EncryptInit_ex(ctx, cipher, nullptr, nullptr, nullptr) == 1
+        && EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_AEAD_SET_IVLEN_ID, 12, nullptr) == 1
+        && EVP_EncryptInit_ex(ctx, nullptr, nullptr, key, nonce) == 1
+        && (aad_len == 0
+            || EVP_EncryptUpdate(ctx, nullptr, &len, aad, (int)aad_len) == 1)
+        && (pt_len == 0
+            || EVP_EncryptUpdate(ctx, out, &len, pt, (int)pt_len) == 1)
+        && EVP_EncryptFinal_ex(ctx, out + pt_len, &len) == 1
+        && EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_AEAD_GET_TAG_ID, 16,
+                               out + pt_len) == 1)
+        ok = true;
+    if (ctx) EVP_CIPHER_CTX_free(ctx);
+    return ok ? 1 : 0;
+}
+
+// Single-shot AEAD open.  Returns the plaintext length (>= 0) on success,
+// -1 on failure (bad args or tag mismatch).
+long aead_open_one(int aead_id, const uint8_t* key, const uint8_t* nonce,
+                   const uint8_t* aad, long aad_len, const uint8_t* ct,
+                   long ct_len, uint8_t* out) {
+    if (aad_len < 0 || ct_len < 16) return -1;
+    size_t pt_len = 0;
+    if (!aead_open(aead_id, key, nonce, aad, (size_t)aad_len, ct,
+                   (size_t)ct_len, out, &pt_len))
+        return -1;
+    return (long)pt_len;
 }
 
 // Batched base-mode HPKE open for DHKEM(X25519, HKDF-SHA256) + HKDF-SHA256.

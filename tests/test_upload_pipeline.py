@@ -1,0 +1,376 @@
+"""The coalesced upload pipeline (aggregator/upload_pipeline.py) held in
+lockstep against the per-report path (`Aggregator._validate_upload_sync`):
+byte-identical problem documents and TaskUploadCounter totals for mixed
+batches, dispatcher-death error delivery (mirrors test_coalesce.py), the
+ReportWriteBatcher flush race, the global-HPKE-cache single flight, and a
+fast burst smoke proving the batched-open path is actually taken."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from janus_tpu import metrics
+from janus_tpu.aggregator import (
+    Aggregator,
+    AggregatorConfig,
+    DapRouter,
+    UploadPipeline,
+)
+from janus_tpu.aggregator import error as err
+from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.core import hpke
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.datastore import ephemeral_datastore
+from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+from janus_tpu.messages import (
+    Duration,
+    InputShareAad,
+    PlaintextInputShare,
+    Report,
+    Role,
+    Time,
+)
+from janus_tpu.models import VdafInstance
+
+
+def _builder():
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          VdafInstance.prio3_count())
+    builder.with_report_expiry_age(Duration(7200))
+    return builder
+
+
+def _agg(builder, clock, pipeline: bool, max_upload_batch: int = 1):
+    ds = ephemeral_datastore(clock)
+    task = builder.leader_view()
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(task))
+    agg = Aggregator(ds, clock, AggregatorConfig(
+        max_upload_batch_size=max_upload_batch,
+        upload_coalesce_enabled=pipeline))
+    return ds, task, agg
+
+
+def _client(builder, clock):
+    return Client(
+        ClientParameters(builder.task_id, "http://l.invalid",
+                         "http://h.invalid", builder.time_precision),
+        VdafInstance.prio3_count(),
+        leader_hpke_config=builder.leader_hpke_keypair.config,
+        helper_hpke_config=builder.helper_hpke_keypair.config,
+        clock=clock)
+
+
+def _counter(ds, task_id):
+    return ds.run_tx("c", lambda tx: tx.get_task_upload_counter(task_id))
+
+
+def _seal_leader(builder, metadata, public_share, plaintext: bytes):
+    aad = InputShareAad(builder.task_id, metadata, public_share).encode()
+    return hpke.seal(
+        builder.leader_hpke_keypair.config,
+        hpke.application_info(hpke.Label.INPUT_SHARE, Role.CLIENT,
+                              Role.LEADER),
+        plaintext, aad)
+
+
+def _mixed_bodies(builder, clock, client, vdaf):
+    """One body per rejection reason plus valid and duplicate entries.
+    Returns [(label, encoded_report)] — the SAME bytes go down both
+    validation paths."""
+    now = clock.now()
+    bodies = []
+    bodies.append(("valid_0", client.prepare_report(0, time=now).encode()))
+    bodies.append(("valid_1", client.prepare_report(1, time=now).encode()))
+
+    bodies.append(("too_early", client.prepare_report(
+        1, time=now.add(Duration(7200))).encode()))
+    bodies.append(("expired", client.prepare_report(
+        1, time=now.sub(Duration(8000))).encode()))
+
+    rogue = HpkeKeypair.generate(200)
+    rogue_client = Client(client.params, VdafInstance.prio3_count(),
+                          leader_hpke_config=rogue.config,
+                          helper_hpke_config=builder.helper_hpke_keypair.config,
+                          clock=clock)
+    bodies.append(("outdated_config",
+                   rogue_client.prepare_report(1, time=now).encode()))
+
+    good = client.prepare_report(1, time=now)
+    bodies.append(("decrypt_failure", Report(
+        good.metadata, good.public_share,
+        type(good.leader_encrypted_input_share)(
+            good.leader_encrypted_input_share.config_id,
+            good.leader_encrypted_input_share.encapsulated_key,
+            b"\x00" * 40),
+        good.helper_encrypted_input_share).encode()))
+
+    # Prio3Count has no joint rand: a non-empty public share must fail the
+    # (vectorized) public-share length check
+    ps_bad = client.prepare_report(1, time=now)
+    bodies.append(("public_share_decode", Report(
+        ps_bad.metadata, b"\x01", ps_bad.leader_encrypted_input_share,
+        ps_bad.helper_encrypted_input_share).encode()))
+
+    # well-formed HPKE seal of a malformed leader share (wrong length)
+    short = client.prepare_report(1, time=now)
+    bodies.append(("input_share_short_decode", Report(
+        short.metadata, short.public_share,
+        _seal_leader(builder, short.metadata, short.public_share,
+                     PlaintextInputShare((), b"\x07" * 3).encode()),
+        short.helper_encrypted_input_share).encode()))
+
+    # correct length, but a non-canonical field element (>= MODULUS): the
+    # numpy range check must agree with field.decode_vec
+    spec_len = ((vdaf.flp.MEAS_LEN + vdaf.proofs * vdaf.flp.PROOF_LEN)
+                * vdaf.field.ENCODED_SIZE)
+    rng = client.prepare_report(1, time=now)
+    bodies.append(("input_share_range_decode", Report(
+        rng.metadata, rng.public_share,
+        _seal_leader(builder, rng.metadata, rng.public_share,
+                     PlaintextInputShare((), b"\xff" * spec_len).encode()),
+        rng.helper_encrypted_input_share).encode()))
+
+    dup = client.prepare_report(1, time=now).encode()
+    bodies.append(("dup_a", dup))
+    bodies.append(("dup_b", dup))
+    return bodies
+
+
+def _put(router, task_id, body):
+    resp = router.handle("PUT", f"/tasks/{task_id}/reports", {}, body, {})
+    return resp.status, resp.body
+
+
+def test_mixed_batch_parity_with_per_report_path():
+    builder = _builder()
+    clock = MockClock(Time(1_700_000_000))
+    ds_pipe, task, agg_pipe = _agg(builder, clock, pipeline=True)
+    ds_sync, _, agg_sync = _agg(builder, clock, pipeline=False)
+    assert agg_pipe.upload_pipeline is not None
+    assert agg_sync.upload_pipeline is None
+
+    client = _client(builder, clock)
+    vdaf = agg_sync.task_aggregator(task.task_id).vdaf
+    bodies = _mixed_bodies(builder, clock, client, vdaf)
+
+    router_sync = DapRouter(agg_sync)
+    want = {label: _put(router_sync, task.task_id, body)
+            for label, body in bodies}
+
+    # the same bytes, but CONCURRENTLY, through the coalescing pipeline
+    router_pipe = DapRouter(agg_pipe)
+    with ThreadPoolExecutor(len(bodies)) as pool:
+        got = dict(zip(
+            (label for label, _ in bodies),
+            pool.map(lambda b: _put(router_pipe, task.task_id, b),
+                     (body for _, body in bodies))))
+    agg_pipe.shutdown()
+
+    for label in want:
+        assert got[label] == want[label], (
+            f"{label}: pipeline {got[label]} != per-report {want[label]}")
+    # statuses cover every rejection class
+    assert want["valid_0"][0] == 201
+    assert want["dup_a"][0] == want["dup_b"][0] == 201
+    assert all(want[k][0] == 400 for k in want
+               if k not in ("valid_0", "valid_1", "dup_a", "dup_b"))
+    assert _counter(ds_pipe, task.task_id) == _counter(ds_sync, task.task_id)
+    c = _counter(ds_pipe, task.task_id)
+    assert c.report_success == 3  # valid x2 + dup counted once
+    assert c.report_too_early == 1
+    assert c.report_expired == 1
+    assert c.report_outdated_key == 1
+    assert c.report_decrypt_failure == 1
+    assert c.report_decode_failure == 3  # public share, short, out-of-range
+
+
+def test_task_expired_parity():
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          VdafInstance.prio3_count())
+    builder.with_task_expiration(Time(1_600_000_000))
+    clock = MockClock(Time(1_700_000_000))
+    ds_pipe, task, agg_pipe = _agg(builder, clock, pipeline=True)
+    ds_sync, _, agg_sync = _agg(builder, clock, pipeline=False)
+    body = _client(builder, clock).prepare_report(
+        1, time=clock.now()).encode()
+
+    want = _put(DapRouter(agg_sync), task.task_id, body)
+    got = _put(DapRouter(agg_pipe), task.task_id, body)
+    agg_pipe.shutdown()
+    assert got == want and want[0] == 400
+    assert (_counter(ds_pipe, task.task_id).task_expired
+            == _counter(ds_sync, task.task_id).task_expired == 1)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_dispatcher_death_delivers_error_and_recovers():
+    """A dispatcher crash must fail every waiting upload with the original
+    error and leave the pipeline restartable (the dispatcher re-raises by
+    design, like CoalescingEngine, so the thread exits loudly)."""
+    builder = _builder()
+    clock = MockClock(Time(1_700_000_000))
+    _, task, agg = _agg(builder, clock, pipeline=True)
+    client = _client(builder, clock)
+    ta = agg.task_aggregator(task.task_id)
+    boom = RuntimeError("dispatcher exploded")
+
+    orig = UploadPipeline._process
+    UploadPipeline._process = lambda self, entries: (_ for _ in ()).throw(boom)
+    try:
+        errors = []
+
+        def submit_one():
+            try:
+                agg.upload_pipeline.submit(
+                    ta, client.prepare_report(1, time=clock.now()))
+            except BaseException as e:  # noqa: BLE001 - asserting delivery
+                errors.append(e)
+
+        threads = [threading.Thread(target=submit_one) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(errors) == 4
+        assert all(e is boom for e in errors)
+    finally:
+        UploadPipeline._process = orig
+
+    # the thread slot was cleared: the next submit restarts the dispatcher
+    agg.upload_pipeline.submit(ta, client.prepare_report(1, time=clock.now()))
+    agg.shutdown()
+
+
+def test_report_write_batcher_flush_race():
+    """Two concurrent flushes: one writes what it drained, the other is a
+    no-op (no empty transaction), and the delay timer is cancelled once."""
+    builder = _builder()
+    clock = MockClock(Time(1_700_000_000))
+    ds = ephemeral_datastore(clock)
+    task = builder.leader_view()
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(task))
+
+    batcher = ReportWriteBatcher(ds, max_batch_size=100,
+                                 max_batch_write_delay_ms=60_000)
+    flush_txs = []
+    orig_run_tx = ds.run_tx
+
+    def counting_run_tx(name, fn):
+        if name == "upload_flush":
+            flush_txs.append(name)
+            time.sleep(0.02)  # widen the race window
+        return orig_run_tx(name, fn)
+
+    ds.run_tx = counting_run_tx
+    try:
+        for _ in range(3):
+            batcher.write_rejection(err.ReportRejection(
+                task.task_id, None, clock.now(),
+                err.ReportRejectionReason.TOO_EARLY))
+        assert batcher._timer is not None  # delay timer armed
+
+        barrier = threading.Barrier(2)
+
+        def racing_flush():
+            barrier.wait()
+            batcher.flush()
+
+        threads = [threading.Thread(target=racing_flush) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert flush_txs == ["upload_flush"]  # exactly one transaction
+        assert batcher._timer is None  # cancelled by whichever drained
+        batcher.flush()  # empty: still no transaction
+        assert flush_txs == ["upload_flush"]
+    finally:
+        ds.run_tx = orig_run_tx
+    assert _counter(ds, task.task_id).report_too_early == 3
+
+
+def test_global_keypair_cache_single_flight():
+    """A cache-expiry burst issues ONE datastore read; the stampede waits
+    on the fetch gate and reuses the cache the winner filled."""
+    builder = _builder()
+    clock = MockClock(Time(1_700_000_000))
+    ds = ephemeral_datastore(clock)
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(builder.leader_view()))
+    ds.run_tx("g", lambda tx: tx.put_global_hpke_keypair(
+        HpkeKeypair.generate(77)))
+    agg = Aggregator(ds, clock, AggregatorConfig())
+
+    reads = []
+    orig_run_tx = ds.run_tx
+
+    def counting_run_tx(name, fn):
+        if name == "get_global_hpke":
+            reads.append(name)
+            time.sleep(0.05)  # make the stampede overlap the fetch
+        return orig_run_tx(name, fn)
+
+    ds.run_tx = counting_run_tx
+    try:
+        with ThreadPoolExecutor(8) as pool:
+            results = list(pool.map(
+                lambda _: agg._global_keypairs_cached(), range(8)))
+    finally:
+        ds.run_tx = orig_run_tx
+    assert len(reads) == 1
+    assert all(len(r) == 1 for r in results)
+
+
+def test_native_aead_matches_softcrypto():
+    """The libcrypto one-shot AEAD the Crypter prefers (native.AesGcm) is
+    wire-identical to the pure-Python fallback: rows written by either
+    decrypt under the other."""
+    from janus_tpu import native
+    from janus_tpu.core.softcrypto import AESGCM as SoftAesGcm, InvalidTag
+
+    if not native.aead_available():
+        pytest.skip("native AEAD unavailable on this host")
+    for key_len in (16, 32):
+        key, nonce, aad = b"k" * key_len, b"n" * 12, b"tbl/row/col"
+        pt = bytes(range(256)) * 3
+        fast, soft = native.AesGcm(key), SoftAesGcm(key)
+        assert fast.encrypt(nonce, pt, aad) == soft.encrypt(nonce, pt, aad)
+        assert fast.encrypt(nonce, b"", None) == soft.encrypt(nonce, b"", None)
+        ct = soft.encrypt(nonce, pt, aad)
+        assert fast.decrypt(nonce, ct, aad) == pt
+        tampered = ct[:-1] + bytes([ct[-1] ^ 1])
+        with pytest.raises(InvalidTag):
+            fast.decrypt(nonce, tampered, aad)
+
+
+def test_burst_smoke_takes_batched_open_path():
+    """100-report burst through the coalescer: everything accepted, and the
+    upload_batch_size histogram proves multi-report batches were formed
+    (i.e. the batched-open path ran, not 100 per-report opens)."""
+    builder = _builder()
+    clock = MockClock(Time(1_700_000_000))
+    ds, task, agg = _agg(builder, clock, pipeline=True, max_upload_batch=100)
+    client = _client(builder, clock)
+    bodies = [client.prepare_report(i % 2, time=clock.now()).encode()
+              for i in range(100)]
+
+    def bucket_counts():
+        for key, counts, _ in metrics.upload_batch_size.snapshot():
+            if key == ():
+                return list(counts)
+        return [0] * (len(metrics.upload_batch_size.buckets) + 1)
+
+    before = bucket_counts()
+    with ThreadPoolExecutor(32) as pool:
+        list(pool.map(lambda b: agg.handle_upload(task.task_id, b), bodies))
+    agg.shutdown()
+
+    assert _counter(ds, task.task_id).report_success == 100
+    delta = [a - b for a, b in zip(bucket_counts(), before)]
+    # buckets (1,2,4,8,16,32,...): index 5+ means a batch of >16 reports
+    assert sum(delta[5:]) >= 1, f"no >16-report batch observed: {delta}"
