@@ -472,9 +472,15 @@ def bench_upload_plane(smoke: bool) -> dict:
         return {le: c - b for le, c, b in zip(bounds, counts, base)
                 if c - b}
 
+    import os as _os
+
+    from janus_tpu import funnel as _funnel
+
+    _funnel.clear()
     rates: dict[str, float] = {}
     dist = None
     backend = None
+    funnel_summary = None
     for mode, pipeline in (("pipeline", True), ("per_report", False)):
         agg = fresh_agg(pipeline)
         burst(agg, bodies())  # untimed warm round (task cache, pools)
@@ -490,6 +496,38 @@ def bench_upload_plane(smoke: bool) -> dict:
                 dict(k).get("backend", "?")
                 for k, v in _metrics.upload_batched_reports.snapshot()
                 if v > before_backends.get(k, 0.0))) or "none"
+            # lifecycle funnel over the pipeline bursts (warm + measured):
+            # stage counts and stage-to-stage loss for the bench task
+            ledger = _funnel.snapshot().get(str(builder.task_id),
+                                            {}).get("leader", {})
+            funnel_summary = {
+                "stages": ledger.get("stages", {}),
+                "loss": ledger.get("loss", {}),
+                "rejected": ledger.get("rejected", {}),
+            }
+
+    # exemplar-capture overhead: the same pipeline burst with trace-exemplar
+    # capture switched off (the acceptance bound is <= 5% on the hot path).
+    # On/off rounds are INTERLEAVED on two fresh aggregators so process
+    # warm-up drift does not bias whichever side runs later.
+    def burst_no_exemplars(agg, bs):
+        _os.environ["JANUS_METRICS_EXEMPLARS"] = "0"
+        try:
+            return burst(agg, bs)
+        finally:
+            _os.environ.pop("JANUS_METRICS_EXEMPLARS", None)
+
+    agg_on, agg_off = fresh_agg(True), fresh_agg(True)
+    burst(agg_on, bodies())  # untimed warm round per aggregator
+    burst_no_exemplars(agg_off, bodies())
+    on_rounds, off_rounds = [], []
+    for _ in range(rounds):
+        on_rounds.append(burst(agg_on, bodies()))
+        off_rounds.append(burst_no_exemplars(agg_off, bodies()))
+    rate_exemplars = sorted(on_rounds)[rounds // 2]
+    rate_no_exemplars = sorted(off_rounds)[rounds // 2]
+    overhead_pct = round((1.0 - rate_exemplars / rate_no_exemplars) * 100,
+                         2)
     from janus_tpu import native
 
     return {
@@ -501,6 +539,13 @@ def bench_upload_plane(smoke: bool) -> dict:
         "workers": workers,
         "batch_size_distribution": dist,  # histogram-bucket le -> batches
         "open_backend": backend,
+        "funnel": funnel_summary,
+        "exemplars": {
+            "enabled_reports_per_sec": round(rate_exemplars, 1),
+            "disabled_reports_per_sec": round(rate_no_exemplars, 1),
+            "overhead_pct": overhead_pct,  # negative = within run-to-run noise
+            "within_5pct": rate_exemplars >= 0.95 * rate_no_exemplars,
+        },
         "includes": "wire decode + coalesced batched HPKE open + vectorized"
                     " validation + bulk flush transaction",
         "native_hpke": native.hpke_available(),

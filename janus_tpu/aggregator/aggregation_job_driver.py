@@ -11,7 +11,7 @@ lease attempts (reference :703)."""
 
 from __future__ import annotations
 
-from janus_tpu import flight_recorder
+from janus_tpu import flight_recorder, funnel, trace, watchdog
 from janus_tpu.aggregator.aggregation_job_writer import (
     AggregationJobWriter,
     WritableReportAggregation,
@@ -60,6 +60,20 @@ class AggregationJobDriver:
 
     def stepper(self, lease: m.Lease) -> None:
         acquired = lease.leased
+        task_id = getattr(acquired, "task_id", None)
+        job_id = getattr(acquired, "aggregation_job_id", None)
+        # step span FIRST, watchdog inside it: the lease registration
+        # captures this trace id, so a later stall verdict links straight
+        # to this step's spans and flight-recorder events
+        with trace.span("aggregation job step", task_id=str(task_id),
+                        job_id=str(job_id)):
+            watchdog.job_leased("aggregation", job_id, task_id=task_id)
+            try:
+                self._stepper_inner(lease, acquired)
+            finally:
+                watchdog.job_done("aggregation", job_id)
+
+    def _stepper_inner(self, lease: m.Lease, acquired) -> None:
         flight_recorder.record(
             "acquired", task_id=getattr(acquired, "task_id", None),
             job_id=getattr(acquired, "aggregation_job_id", None),
@@ -219,9 +233,11 @@ class AggregationJobDriver:
             msgs.append(msg)
             ras_resp.append(ra)
 
+        n_finished = 0
         finished = engine.leader_finish(reps, msgs)
         for ra, rep in zip(ras_resp, finished):
             if rep.status == "finished":
+                n_finished += 1
                 writables.append(WritableReportAggregation(
                     ra.with_state(m.ReportAggregationState.finished()),
                     rep.out_share_raw, device_shares=rep.device_shares,
@@ -251,6 +267,11 @@ class AggregationJobDriver:
 
         job = job.with_step(job.step.increment())
         self._finalize(task, engine, job, writables, lease)
+        # funnel: count after the write committed; only FRESH transitions
+        # (starts entering aggregation, lanes finishing THIS step — the
+        # _finalize path re-sees unchanged writables and must not recount)
+        funnel.count("agg_init", task.task_id, len(starts))
+        funnel.count("prepare_done", task.task_id, n_finished)
 
     def _step_continue(self, task, engine, job, ras, lease) -> None:
         """Evaluate persisted transitions, run one continue exchange, fold
@@ -289,6 +310,7 @@ class AggregationJobDriver:
             resp = AggregationJobResp.decode(result.body)
             helper_resp = {bytes(pr.report_id): pr for pr in resp.prepare_resps}
 
+        n_finished = 0
         for ra, outbound, state in continues:
             pr = helper_resp.get(bytes(ra.report_id))
             if pr is None or pr.result.kind == PrepareStepResult.REJECT:
@@ -297,6 +319,7 @@ class AggregationJobDriver:
                         PrepareError.VDAF_PREP_ERROR))))
                 continue
             if state.finished:
+                n_finished += 1
                 writables.append(WritableReportAggregation(
                     ra.with_state(m.ReportAggregationState.finished()),
                     state.out_share))
@@ -309,6 +332,7 @@ class AggregationJobDriver:
                     msg = ping_pong.PingPongMessage.decode(pr.result.message)
                     res = ping_pong.continued(vdaf, state, msg)
                     if getattr(res, "finished", False):
+                        n_finished += 1
                         writables.append(WritableReportAggregation(
                             ra.with_state(m.ReportAggregationState.finished()),
                             res.out_share))
@@ -324,6 +348,7 @@ class AggregationJobDriver:
 
         job = job.with_step(job.step.increment())
         self._finalize(task, engine, job, writables, lease)
+        funnel.count("prepare_done", task.task_id, n_finished)
 
     def _finalize(self, task, engine, job, writables, lease) -> None:
         def txn(tx):

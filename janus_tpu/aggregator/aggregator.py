@@ -18,7 +18,7 @@ import time as _time
 import numpy as np
 from dataclasses import dataclass, field
 
-from janus_tpu import flight_recorder
+from janus_tpu import flight_recorder, funnel
 from janus_tpu.aggregator import error as err
 from janus_tpu.aggregator.aggregation_job_writer import (
     AggregationJobWriter,
@@ -217,7 +217,10 @@ class Aggregator:
             max_batch_size=self.cfg.max_upload_batch_size,
             max_batch_write_delay_ms=self.cfg.max_upload_batch_write_delay_ms,
         )
+        from janus_tpu import watchdog
         from janus_tpu.aggregator.upload_pipeline import UploadPipeline
+
+        watchdog.register_report_writer(self.report_writer)
 
         self.upload_pipeline = (
             UploadPipeline(
@@ -372,10 +375,12 @@ class Aggregator:
         asserts byte-identical verdicts)."""
         task = ta.task
         task_id = task.task_id
+        funnel.count("uploaded", task_id)
 
         def reject(reason: err.ReportRejectionReason):
             rejection = err.ReportRejection(
                 task_id, report.metadata.report_id, report.metadata.time, reason)
+            funnel.reject(task_id, reason)
             self.report_writer.write_rejection(rejection)
             raise err.ReportRejected(rejection)
 
@@ -429,6 +434,7 @@ class Aggregator:
             leader_input_share=pis.payload,
             helper_encrypted_input_share=report.helper_encrypted_input_share,
         )
+        funnel.count("validated", task_id)
         self.report_writer.write_report(task, ta.logic, stored)
 
     def _global_keypair(self, config_id):
@@ -770,8 +776,13 @@ class Aggregator:
         )
         _mark("assemble")
 
-        # Phase 4 (tx): replay/idempotency + writes.
+        # Phase 4 (tx): replay/idempotency + writes.  Funnel tallies are
+        # collected inside the txn (a replayed request must not recount)
+        # but counted only after commit (the closure can retry).
+        tally: dict[str, int] = {}
+
         def txn(tx):
+            tally.clear()
             existing = tx.get_aggregation_job(task_id, job_id)
             if existing is not None:
                 if existing.state is m.AggregationJobState.DELETED:
@@ -823,12 +834,21 @@ class Aggregator:
                 shard_count=self.cfg.batch_aggregation_shard_count,
                 initial=True)
             final = writer.write(tx, job, final)
+            tally["agg_init"] = len(final)
+            tally["prepare_done"] = sum(
+                1 for w in final
+                if w.report_aggregation.state.kind
+                is m.ReportAggregationStateKind.FINISHED)
             return AggregationJobResp(tuple(
                 w.report_aggregation.last_prep_resp for w in final
             ))
 
         resp = self.datastore.run_tx("aggregate_init", txn)
         _mark("tx")
+        funnel.count("agg_init", task_id, tally.get("agg_init", 0),
+                     role="helper")
+        funnel.count("prepare_done", task_id, tally.get("prepare_done", 0),
+                     role="helper")
         out = resp.encode()
         _mark("resp_encode")
         # phase-time observability: consumed by bench.py and /debug/state
@@ -1252,7 +1272,12 @@ class Aggregator:
                                              handle)
             pre_agg[key] = (frozenset(fin0), fut)
 
+        # funnel tallies: collected in-txn (replayed requests must not
+        # recount), counted after commit (the closure can retry)
+        tally: dict[str, int] = {}
+
         def txn(tx):
+            tally.clear()
             existing = tx.get_aggregation_job(task_id, job_id)
             if existing is not None:
                 if existing.state is m.AggregationJobState.DELETED:
@@ -1392,11 +1417,18 @@ class Aggregator:
                     terminated_delta=1)
 
             _tmark("tx_accumulate")
+            tally["agg_init"] = n
+            tally["prepare_done"] = sum(1 for i in range(n)
+                                        if kinds[i] == 0)
             total = sum(len(p) for p in resp_parts)
             return pk(">I", total) + b"".join(resp_parts)
 
         resp = self.datastore.run_tx("aggregate_init", txn)
         _mark("tx")
+        funnel.count("agg_init", task_id, tally.get("agg_init", 0),
+                     role="helper")
+        funnel.count("prepare_done", task_id, tally.get("prepare_done", 0),
+                     role="helper")
         self.last_init_timings = t_phase
         flight_recorder.record(
             "helper_init", task_id=task_id, job_id=job_id, kind="aggregation",
@@ -1526,6 +1558,12 @@ class Aggregator:
             writables.append(WritableReportAggregation(ra, out_share))
 
         job = job.with_step(req.step).with_last_request_hash(request_hash)
+        # fresh finished transitions this step (WAITING_HELPER lanes that
+        # just completed preparation) — counted after the commit
+        finished_now = sum(
+            1 for w in writables
+            if w.report_aggregation.state.kind
+            is m.ReportAggregationStateKind.FINISHED)
 
         def txn(tx):
             writer = AggregationJobWriter(
@@ -1538,6 +1576,7 @@ class Aggregator:
             ))
 
         resp = self.datastore.run_tx("aggregate_continue", txn)
+        funnel.count("prepare_done", task_id, finished_now, role="helper")
         return resp.encode()
 
     # -- aggregation job delete -------------------------------------------
@@ -1686,7 +1725,12 @@ class Aggregator:
             raise err.InvalidMessage(f"bad aggregation parameter: {e}",
                                      task_id) from e
 
+        # funnel tally: only a FRESH share job counts as collected (the
+        # cached-job path re-serves); counted after commit (txn can retry)
+        tally: dict[str, int] = {}
+
         def txn(tx):
+            tally.clear()
             # Idempotency: a cached AggregateShareJob is re-served
             # (reference aggregator.rs:2859).
             existing = tx.get_aggregate_share_job(
@@ -1726,9 +1770,12 @@ class Aggregator:
             )
             tx.put_batch_query(task_id, ident, req.aggregation_parameter)
             tx.put_aggregate_share_job(asj)
+            tally["collected"] = count
             return asj
 
         asj = self.datastore.run_tx("aggregate_share", txn)
+        funnel.count("collected", task_id, tally.get("collected", 0),
+                     role="helper")
 
         aad = AggregateShareAad(task_id, req.aggregation_parameter,
                                 req.batch_selector).encode()

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from dataclasses import replace
 
-from janus_tpu import flight_recorder
+from janus_tpu import flight_recorder, funnel, trace, watchdog
 from janus_tpu.aggregator.aggregator import merge_batch_aggregations
 from janus_tpu.aggregator.http_client import PeerClient, PeerHttpError
 from janus_tpu.aggregator.query_type import logic_for
@@ -53,6 +53,19 @@ class CollectionJobDriver:
 
     def stepper(self, lease: m.Lease) -> None:
         acquired = lease.leased
+        task_id = getattr(acquired, "task_id", None)
+        job_id = getattr(acquired, "collection_job_id", None)
+        # step span FIRST, watchdog inside it: the lease registration
+        # captures this trace id for stall-verdict linkage
+        with trace.span("collection job step", task_id=str(task_id),
+                        job_id=str(job_id)):
+            watchdog.job_leased("collection", job_id, task_id=task_id)
+            try:
+                self._stepper_inner(lease, acquired)
+            finally:
+                watchdog.job_done("collection", job_id)
+
+    def _stepper_inner(self, lease: m.Lease, acquired) -> None:
         flight_recorder.record(
             "acquired", task_id=getattr(acquired, "task_id", None),
             job_id=getattr(acquired, "collection_job_id", None),
@@ -188,6 +201,7 @@ class CollectionJobDriver:
             tx.release_collection_job(lease)
 
         self.datastore.run_tx("coll_job_finish", finish)
+        funnel.count("collected", task_id, count)
         flight_recorder.record(
             "stepped", task_id=task_id, job_id=job_id, kind="collection",
             state="finished", reports=count)

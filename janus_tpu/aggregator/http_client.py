@@ -3,7 +3,9 @@
 
 from __future__ import annotations
 
-from janus_tpu import trace
+import time as _time
+
+from janus_tpu import metrics, trace
 from janus_tpu.core.retries import Backoff, HttpResult, retry_http_request
 from janus_tpu.datastore.task import AggregatorTask
 
@@ -58,7 +60,13 @@ class PeerClient:
             ctx = trace.current_context()
             if ctx is not None and trace.propagation_enabled():
                 headers["traceparent"] = trace.format_traceparent(ctx)
-            result = retry_http_request(attempt, self.backoff)
+            t0 = _time.monotonic()
+            try:
+                result = retry_http_request(attempt, self.backoff)
+            finally:
+                # round-trip incl. retries: the SLO engine's helper_rtt SLI
+                metrics.helper_rtt_seconds.observe(_time.monotonic() - t0,
+                                                   method=method)
         if not 200 <= result.status < 300:
             raise PeerHttpError(result.status, result.body)
         return result

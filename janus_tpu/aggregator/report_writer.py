@@ -22,7 +22,7 @@ from __future__ import annotations
 import random
 import threading
 
-from janus_tpu import metrics
+from janus_tpu import funnel, metrics
 from janus_tpu.datastore import models as m
 from janus_tpu.datastore.datastore import Datastore, MutationTargetAlreadyExists
 
@@ -65,6 +65,11 @@ class ReportWriteBatcher:
         if drained[0] or drained[1]:
             self._write(*drained)
 
+    def pending_count(self) -> int:
+        """Buffered-but-unflushed work, for the stall watchdog."""
+        with self._lock:
+            return len(self._buffer) + len(self._rejections)
+
     # -- machinery ---------------------------------------------------------
 
     def _append(self, reports: tuple, rejections: tuple) -> None:
@@ -103,7 +108,18 @@ class ReportWriteBatcher:
     def _write(self, buffer: list, rejections: list) -> None:
         from janus_tpu.aggregator.error import ReportRejectionReason
 
+        # funnel tallies collected inside the transaction but counted only
+        # after run_tx returns: the closure can retry, and counting inside
+        # would double-count every retried attempt
+        stats: dict[str, dict[str, int]] = {}
+
+        def _tally(bucket: str, task_id) -> None:
+            d = stats.setdefault(bucket, {})
+            k = str(task_id)
+            d[k] = d.get(k, 0) + 1
+
         def txn(tx):
+            stats.clear()
             success_by_task: dict[bytes, int] = {}
             for task, logic, report in buffer:
                 key = bytes(task.task_id)
@@ -111,14 +127,17 @@ class ReportWriteBatcher:
                     tx.increment_task_upload_counter(
                         task.task_id, random.randrange(COUNTER_SHARDS),
                         m.TaskUploadCounter(interval_collected=1))
+                    _tally("interval_collected", task.task_id)
                     continue
                 try:
                     tx.put_client_report(report)
                 except MutationTargetAlreadyExists:
                     # Duplicate upload: drop silently unless content differs
                     # (either way, not a batch-fatal event).
+                    _tally("duplicate", task.task_id)
                     continue
                 success_by_task[key] = success_by_task.get(key, 0) + 1
+                _tally("stored", task.task_id)
             for task, _logic, _report in buffer:
                 key = bytes(task.task_id)
                 n = success_by_task.pop(key, 0)
@@ -141,3 +160,10 @@ class ReportWriteBatcher:
                     m.TaskUploadCounter(**{counter_field[rejection.reason]: 1}))
 
         self.datastore.run_tx("upload_flush", txn)
+        for task_id, n in stats.get("stored", {}).items():
+            funnel.count("stored", task_id, n)
+        for task_id, n in stats.get("interval_collected", {}).items():
+            funnel.reject(task_id, ReportRejectionReason.INTERVAL_COLLECTED,
+                          n)
+        for task_id, n in stats.get("duplicate", {}).items():
+            funnel.reject(task_id, "duplicate", n)

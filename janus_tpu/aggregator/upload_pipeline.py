@@ -42,7 +42,8 @@ import time as _time
 
 import numpy as np
 
-from janus_tpu import flight_recorder, metrics, profiler
+from janus_tpu import flight_recorder, funnel, metrics, profiler, trace, \
+    watchdog
 from janus_tpu.aggregator import error as err
 from janus_tpu.core import hpke
 from janus_tpu.datastore import models as m
@@ -144,6 +145,7 @@ class UploadPipeline:
         self._lock = threading.Lock()
         self._queue: list[_PendingUpload] = []
         self._dispatcher: threading.Thread | None = None
+        watchdog.register_upload_pipeline(self)
 
     # -- entry point -------------------------------------------------------
 
@@ -165,6 +167,19 @@ class UploadPipeline:
             raise p.error
         if p.rejection is not None:
             raise err.ReportRejected(p.rejection)
+
+    def queue_stats(self) -> dict:
+        """Dispatcher liveness for the stall watchdog: queued waiters, a
+        live dispatcher thread, and the oldest waiter's park time."""
+        now = _time.monotonic()
+        with self._lock:
+            queued = len(self._queue)
+            oldest = min((p.enq_t for p in self._queue), default=None)
+            t = self._dispatcher
+            alive = t is not None and t.is_alive()
+        return {"queued": queued, "dispatcher_alive": alive,
+                "oldest_wait_s": (now - oldest) if oldest is not None
+                else 0.0}
 
     def drain(self, timeout: float = 5.0) -> None:
         """Wait for queued uploads to resolve (shutdown path)."""
@@ -212,6 +227,14 @@ class UploadPipeline:
             p.report.metadata.time, reason)
 
     def _process(self, entries: list[_PendingUpload]) -> None:
+        # one batch = one span: the phase histograms observed inside pick
+        # up this trace as their exemplar, and the upload_batch
+        # flight-recorder event carries the same trace_id — a slow bucket
+        # in the exposition resolves to this exact batch
+        with trace.span("upload batch", reports=len(entries)):
+            self._process_batch(entries)
+
+    def _process_batch(self, entries: list[_PendingUpload]) -> None:
         t0 = _time.monotonic()
         for p in entries:
             metrics.upload_queue_delay.observe(t0 - p.enq_t)
@@ -221,6 +244,8 @@ class UploadPipeline:
         by_task: dict[bytes, list[_PendingUpload]] = {}
         for p in entries:
             by_task.setdefault(bytes(p.ta.task.task_id), []).append(p)
+        for group in by_task.values():
+            funnel.count("uploaded", group[0].ta.task.task_id, len(group))
 
         # phase 1: vectorized cheap validation; survivors become open lanes
         lanes: list[tuple] = []       # (keypair, ciphertext, aad)
@@ -284,6 +309,20 @@ class UploadPipeline:
                 helper_encrypted_input_share=p.report.helper_encrypted_input_share,
             )
             accepted.append((p.ta.task, p.ta.logic, stored))
+        # funnel accounting, whole-batch counts per task (hot-path
+        # discipline: one add per task per batch)
+        val_by_task: dict[str, int] = {}
+        for task, _logic, _stored in accepted:
+            k = str(task.task_id)
+            val_by_task[k] = val_by_task.get(k, 0) + 1
+        for k, cnt in val_by_task.items():
+            funnel.count("validated", k, cnt)
+        rej_by: dict[tuple, int] = {}
+        for r in rejections:
+            rk = (str(r.task_id), r.reason)
+            rej_by[rk] = rej_by.get(rk, 0) + 1
+        for (k, reason), cnt in rej_by.items():
+            funnel.reject(k, reason, cnt)
         self.aggregator.report_writer.write_upload_batch(accepted, rejections)
         t4 = _time.monotonic()
 

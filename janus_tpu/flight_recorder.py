@@ -61,12 +61,20 @@ class FlightRecorder:
             pass  # the recorder must never take the data plane down
 
     def snapshot(self, job_id: str | None = None,
-                 limit: int | None = None) -> list[dict]:
-        """Recent events, oldest first; optionally filtered by job id."""
+                 limit: int | None = None, since: int | None = None,
+                 event: str | None = None) -> list[dict]:
+        """Recent events, oldest first.  Filters: `job_id`; `event` (exact
+        event name); `since` (only events with seq > since — pass the last
+        seq you saw to page the ring without missing or re-reading
+        entries, as seqs are monotonic even after ring eviction)."""
         with self._lock:
             events = list(self._events)
         if job_id is not None:
             events = [e for e in events if e.get("job_id") == str(job_id)]
+        if event is not None:
+            events = [e for e in events if e.get("event") == event]
+        if since is not None:
+            events = [e for e in events if e.get("seq", 0) > since]
         if limit is not None:
             events = events[-limit:]
         return events
@@ -89,8 +97,11 @@ def record(event: str, *, task_id=None, job_id=None, **fields) -> None:
     RECORDER.record(event, task_id=task_id, job_id=job_id, **fields)
 
 
-def snapshot(job_id: str | None = None, limit: int | None = None) -> list[dict]:
-    return RECORDER.snapshot(job_id=job_id, limit=limit)
+def snapshot(job_id: str | None = None, limit: int | None = None,
+             since: int | None = None,
+             event: str | None = None) -> list[dict]:
+    return RECORDER.snapshot(job_id=job_id, limit=limit, since=since,
+                             event=event)
 
 
 def clear() -> None:

@@ -1,0 +1,114 @@
+"""Per-task report-lifecycle funnel: end-to-end loss accounting.
+
+Every report that touches this process is counted through the lifecycle
+stages
+
+    uploaded -> validated -> stored -> agg_init -> prepare_done -> collected
+
+plus one ``rejected_<reason>`` bucket per rejection reason, keyed by
+``(task_id, role)`` so an in-process leader+helper pair (tests,
+compose_e2e) keeps its two ledgers apart.  The instrumented call sites:
+
+  * ``aggregator/upload_pipeline.py`` and ``Aggregator._validate_upload_sync``
+    count ``uploaded`` / ``validated`` / rejections on the leader,
+  * ``aggregator/report_writer.py`` counts ``stored`` (reports that
+    actually landed in the flush transaction) and the in-transaction
+    rejections (duplicates, collected intervals),
+  * ``aggregator/aggregation_job_driver.py`` counts ``agg_init`` /
+    ``prepare_done`` on the leader; the helper path in
+    ``aggregator/aggregator.py`` (object + columnar init, continue)
+    counts the same stages on the helper,
+  * ``aggregator/collection_job_driver.py`` and
+    ``Aggregator.handle_aggregate_share`` count ``collected``.
+
+Counts are stored in ONE metrics counter
+(``janus_funnel_reports_total{task_id,role,stage}``) so the funnel rides
+the existing /metrics + OTLP export for free; ``snapshot()`` re-derives
+the per-task view with stage-to-stage loss deltas for the
+``/debug/funnel`` console endpoint (janus_tpu.health).
+
+Hot-path discipline: callers count whole batches (one ``add`` per task
+per batch), never per report, and counting must never take the data
+plane down — ``count``/``reject`` swallow their own failures.
+"""
+
+from __future__ import annotations
+
+from janus_tpu import metrics
+
+# Lifecycle stages in pipeline order.  Loss deltas are computed between
+# adjacent stages that are both present for a (task, role) ledger.
+STAGES = ("uploaded", "validated", "stored", "agg_init", "prepare_done",
+          "collected")
+
+reports_total = metrics.REGISTRY.counter(
+    "janus_funnel_reports_total",
+    "report-lifecycle funnel: reports per task/role reaching each stage "
+    "(uploaded/validated/stored/agg_init/prepare_done/collected or a "
+    "rejected_<reason> bucket)")
+
+
+def _task_label(task_id) -> str:
+    return str(task_id)
+
+
+def count(stage: str, task_id, n: int = 1, role: str = "leader") -> None:
+    """Count `n` reports of `task_id` reaching `stage`."""
+    if n <= 0:
+        return
+    try:
+        reports_total.add(n, task_id=_task_label(task_id), role=role,
+                          stage=stage)
+    except Exception:
+        pass  # accounting must never take the data plane down
+
+
+def reject(task_id, reason, n: int = 1, role: str = "leader") -> None:
+    """Count `n` reports of `task_id` rejected for `reason` (an enum
+    member, or a plain string)."""
+    name = getattr(reason, "name", None) or str(reason)
+    count(f"rejected_{name.lower()}", task_id, n, role=role)
+
+
+def snapshot() -> dict:
+    """Per-task funnel view for /debug/funnel:
+
+        {task_id: {role: {"stages": {stage: n}, "rejected": {reason: n},
+                          "loss": {stage: delta}}}}
+
+    ``loss[stage]`` is how many reports reached the nearest earlier
+    present stage but not `stage` (clamped at 0: retries/replays can
+    legitimately push a later stage above an earlier one).
+    """
+    tasks: dict = {}
+    for key, v in reports_total.snapshot():
+        labels = dict(key)
+        task = labels.get("task_id", "?")
+        role = labels.get("role", "?")
+        stage = labels.get("stage", "?")
+        ledger = tasks.setdefault(task, {}).setdefault(
+            role, {"stages": {}, "rejected": {}})
+        if stage.startswith("rejected_"):
+            ledger["rejected"][stage[len("rejected_"):]] = int(v)
+        else:
+            ledger["stages"][stage] = int(v)
+    for roles in tasks.values():
+        for ledger in roles.values():
+            stages = ledger["stages"]
+            loss: dict = {}
+            prev = None
+            for stage in STAGES:
+                if stage not in stages:
+                    continue
+                if prev is not None:
+                    loss[stage] = max(stages[prev] - stages[stage], 0)
+                prev = stage
+            ledger["loss"] = loss
+            ledger["rejected_total"] = sum(ledger["rejected"].values())
+    return tasks
+
+
+def clear() -> None:
+    """Reset the funnel ledger (tests, bench)."""
+    with reports_total._lock:
+        reports_total._values.clear()

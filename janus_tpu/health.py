@@ -5,16 +5,26 @@ reference's feature-gated tokio-console runtime introspection,
 trace.rs:66).
 
     GET /healthz        -> 200 "ok"
-    GET /metrics        -> Prometheus text format
+    GET /metrics        -> Prometheus text format; with an Accept header
+                           containing "application/openmetrics-text" (or
+                           JANUS_OPENMETRICS=1), the OpenMetrics variant
+                           with trace exemplars on histogram buckets
     GET /debug/state    -> JSON: threads (name/state/stack top), device
                            engines (fallbacks, cumulative time split,
                            compiled-kernel count), process stats
     GET /debug/jobs     -> JSON: flight-recorder ring of recent per-job
-                           lifecycle events (?job_id= filters, ?limit=
-                           caps the tail)
+                           lifecycle events (?job_id= / ?event= filter,
+                           ?limit= caps the tail, ?since=<seq> pages —
+                           only events with seq > since)
     GET /debug/profile  -> JSON: per-batch device-engine phase records
                            (decode/compile/execute/encode, occupancy)
                            plus aggregate summary and per-engine totals
+    GET /debug/funnel   -> JSON: per-task report-lifecycle funnel with
+                           stage totals and loss deltas (janus_tpu.funnel)
+    GET /debug/slo      -> JSON: SLI burn rates / budget remaining per
+                           objective (janus_tpu.slo; samples on request)
+    GET /debug/watchdog -> JSON: stall-detector verdict (janus_tpu.watchdog;
+                           runs the detectors on request)
 
 The /debug/* routes share the JANUS_DEBUG_CONSOLE gate.
 """
@@ -92,16 +102,26 @@ def _debug_jobs(query: dict) -> dict:
     from janus_tpu import flight_recorder
 
     job_id = query.get("job_id")
+    event = query.get("event")
     limit = None
     if query.get("limit"):
         try:
             limit = max(1, int(query["limit"]))
         except ValueError:
             limit = None
-    events = flight_recorder.snapshot(job_id=job_id, limit=limit)
+    since = None
+    if query.get("since"):
+        try:
+            since = int(query["since"])
+        except ValueError:
+            since = None
+    events = flight_recorder.snapshot(job_id=job_id, limit=limit,
+                                      since=since, event=event)
     return {
         "capacity": flight_recorder.RECORDER.capacity,
         "count": len(events),
+        # resume cursor: pass back as ?since= to page without re-reading
+        "last_seq": events[-1]["seq"] if events else (since or 0),
         "events": events,
     }
 
@@ -138,6 +158,41 @@ def _debug_profile(query: dict) -> dict:
     }
 
 
+def _debug_funnel(query: dict) -> dict:
+    from janus_tpu import funnel
+
+    tasks = funnel.snapshot()
+    task_filter = query.get("task_id")
+    if task_filter is not None:
+        tasks = {t: v for t, v in tasks.items() if t == task_filter}
+    return {"stages": list(funnel.STAGES), "tasks": tasks}
+
+
+def _debug_slo(query: dict) -> dict:
+    from janus_tpu import slo
+
+    engine = slo.get_engine()
+    engine.sample()
+    return engine.evaluate()
+
+
+def _debug_watchdog(query: dict) -> dict:
+    from janus_tpu import watchdog
+
+    return watchdog.check_now()
+
+
+def _openmetrics_requested(accept: str) -> bool:
+    """Content negotiation for /metrics: the OpenMetrics exposition (with
+    exemplars) is served when the scraper asks for it or when forced by
+    JANUS_OPENMETRICS; plain Prometheus text stays the default."""
+    import os
+
+    if os.environ.get("JANUS_OPENMETRICS", "") not in ("", "0", "false"):
+        return True
+    return "application/openmetrics-text" in (accept or "")
+
+
 def _debug_console_enabled() -> bool:
     """The runtime console is opt-in (reference gates tokio-console behind a
     feature flag, trace.rs:66): it exposes thread stacks and engine
@@ -172,14 +227,23 @@ class HealthServer:
                     body = b"ok"
                     ctype = "text/plain"
                 elif path == "/metrics":
-                    body = REGISTRY.exposition().encode()
-                    ctype = "text/plain; version=0.0.4"
-                elif path in ("/debug/state", "/debug/jobs",
-                              "/debug/profile") and debug_console:
+                    if _openmetrics_requested(self.headers.get("Accept")):
+                        body = REGISTRY.exposition(openmetrics=True).encode()
+                        ctype = ("application/openmetrics-text; "
+                                 "version=1.0.0; charset=utf-8")
+                    else:
+                        body = REGISTRY.exposition().encode()
+                        ctype = "text/plain; version=0.0.4"
+                elif path in ("/debug/state", "/debug/jobs", "/debug/profile",
+                              "/debug/funnel", "/debug/slo",
+                              "/debug/watchdog") and debug_console:
                     try:
                         payload = {"/debug/state": _debug_state,
                                    "/debug/jobs": _debug_jobs,
-                                   "/debug/profile": _debug_profile}[path]
+                                   "/debug/profile": _debug_profile,
+                                   "/debug/funnel": _debug_funnel,
+                                   "/debug/slo": _debug_slo,
+                                   "/debug/watchdog": _debug_watchdog}[path]
                         data = (payload() if path == "/debug/state"
                                 else payload(query))
                         body = json.dumps(data, indent=1).encode()

@@ -11,11 +11,21 @@ server (janus_tpu.health).
 
 from __future__ import annotations
 
+import os
 import threading
+import time as _time
 from bisect import bisect_right
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
                     2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def exemplars_enabled() -> bool:
+    """Trace-exemplar capture on Histogram.observe, on unless
+    JANUS_METRICS_EXEMPLARS is set to 0/false/off (the bench kill-switch
+    for measuring capture overhead)."""
+    val = os.environ.get("JANUS_METRICS_EXEMPLARS", "1").strip().lower()
+    return val not in ("0", "false", "off", "no")
 
 
 class Counter:
@@ -56,14 +66,31 @@ class Histogram:
         self.buckets = tuple(buckets)
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
+        # label_key -> [exemplar|None per bucket]; an exemplar is
+        # (value, unix_ts, trace_id, span_id) — the LAST traced observation
+        # to land in that bucket (OpenMetrics exemplars, Dapper-style
+        # metric->trace linkage)
+        self._exemplars: dict[tuple, list] = {}
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
+        exemplar = None
+        if exemplars_enabled():
+            from janus_tpu import trace
+
+            ctx = trace.current_context()
+            if ctx is not None:
+                exemplar = (value, _time.time(), ctx.trace_id, ctx.span_id)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
-            counts[bisect_right(self.buckets, value)] += 1
+            idx = bisect_right(self.buckets, value)
+            counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+            if exemplar is not None:
+                ex = self._exemplars.setdefault(
+                    key, [None] * (len(self.buckets) + 1))
+                ex[idx] = exemplar
 
     def count(self, **labels) -> int:
         key = tuple(sorted(labels.items()))
@@ -76,18 +103,31 @@ class Histogram:
             return [(key, list(counts), self._sums.get(key, 0.0))
                     for key, counts in sorted(self._counts.items())]
 
-    def _render(self) -> list[str]:
+    def exemplars_snapshot(self) -> list[tuple]:
+        """[(label_key, [exemplar|None per bucket])] — exemplar is
+        (value, unix_ts, trace_id, span_id)."""
+        with self._lock:
+            return [(key, list(ex))
+                    for key, ex in sorted(self._exemplars.items())]
+
+    def _render(self, openmetrics: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
             for key, counts in sorted(self._counts.items()):
+                exemplars = self._exemplars.get(key)
                 cum = 0
-                for bound, c in zip(self.buckets, counts):
+                for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                     cum += c
-                    out.append(
-                        f"{self.name}_bucket{_labelstr(key, le=bound)} {cum}")
+                    line = f"{self.name}_bucket{_labelstr(key, le=bound)} {cum}"
+                    if openmetrics and exemplars and exemplars[i]:
+                        line += _exemplar_suffix(exemplars[i])
+                    out.append(line)
                 cum += counts[-1]
-                out.append(f'{self.name}_bucket{_labelstr(key, le="+Inf")} {cum}')
+                line = f'{self.name}_bucket{_labelstr(key, le="+Inf")} {cum}'
+                if openmetrics and exemplars and exemplars[-1]:
+                    line += _exemplar_suffix(exemplars[-1])
+                out.append(line)
                 out.append(f"{self.name}_sum{_labelstr(key)} {self._sums[key]}")
                 out.append(f"{self.name}_count{_labelstr(key)} {cum}")
         return out
@@ -142,6 +182,14 @@ def _labelstr(key, le=None) -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _exemplar_suffix(exemplar: tuple) -> str:
+    """OpenMetrics exemplar syntax appended to a bucket sample:
+    ` # {trace_id="..",span_id=".."} <value> <timestamp>`."""
+    value, ts, trace_id, span_id = exemplar
+    return (f' # {{trace_id="{trace_id}",span_id="{span_id}"}}'
+            f" {value} {round(ts, 3)}")
+
+
 class Registry:
     def __init__(self):
         self._metrics: list = []
@@ -175,12 +223,20 @@ class Registry:
             self._metrics.append(g)
             return g
 
-    def exposition(self) -> str:
+    def exposition(self, openmetrics: bool = False) -> str:
+        """Prometheus text format; with `openmetrics`, histogram buckets
+        carry trace exemplars and the exposition ends with `# EOF`
+        (served under content negotiation by janus_tpu.health)."""
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics)
         for m_ in metrics:
-            lines.extend(m_._render())
+            if openmetrics and isinstance(m_, Histogram):
+                lines.extend(m_._render(openmetrics=True))
+            else:
+                lines.extend(m_._render())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def all(self) -> list:
@@ -254,6 +310,10 @@ upload_open_stragglers = REGISTRY.counter(
     "janus_upload_open_stragglers",
     "upload lanes a batched HPKE open failed and the per-report path "
     "retried, by outcome (recovered/failed)")
+# leader->helper round-trip latency (http_client.py), an SLO engine input
+helper_rtt_seconds = REGISTRY.histogram(
+    "janus_helper_rtt_seconds",
+    "leader->helper request round-trip latency (incl. retries) by method")
 
 
 def all_instruments() -> list:
@@ -325,3 +385,34 @@ def lint_exposition(text: str) -> list[str]:
             errors.append(
                 f"line {i}: sample {name!r} has no # TYPE declaration")
     return errors
+
+
+def lint_instruments(instruments=None, prefix: str = "janus_",
+                     max_label_sets: int = 512,
+                     allow_prefixes: tuple = ("test_",)) -> list[str]:
+    """Instrument-hygiene lint over the live registry: every instrument
+    must carry help text, wear the process namespace prefix, and keep its
+    label-set cardinality below `max_label_sets` (a runaway label —
+    report ids, raw error strings — silently bloats every scrape and
+    breaks downstream aggregation).  Instruments whose name starts with
+    one of `allow_prefixes` (test fixtures) skip the prefix check.
+    Returns human-readable problems; empty means clean."""
+    problems: list[str] = []
+    if instruments is None:
+        instruments = all_instruments()
+    for inst in instruments:
+        name = inst.name
+        if not inst.help:
+            problems.append(f"{name}: missing help text")
+        if (not name.startswith(prefix)
+                and not any(name.startswith(p) for p in allow_prefixes)):
+            problems.append(f"{name}: missing {prefix!r} prefix")
+        try:
+            cardinality = len(inst.snapshot())
+        except Exception:
+            cardinality = 0
+        if cardinality > max_label_sets:
+            problems.append(
+                f"{name}: {cardinality} label sets exceeds the "
+                f"{max_label_sets} cardinality threshold")
+    return problems

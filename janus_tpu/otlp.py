@@ -68,6 +68,10 @@ class OtlpExporter:
         # Registry instance (.all)
         self._instruments = getattr(registry, "all_instruments", None) \
             or registry.all
+        # cumulative-temporality points need a constant series start time
+        # (aggregationTemporality 2 without startTimeUnixNano is rejected
+        # by many backends); one stamp for the exporter's lifetime
+        self._start_ns = _now_ns()
         self._spans: list[dict] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -80,16 +84,28 @@ class OtlpExporter:
         ms = []
         for inst in self._instruments():
             if hasattr(inst, "buckets"):  # histogram
+                exemplars = dict(inst.exemplars_snapshot()) \
+                    if hasattr(inst, "exemplars_snapshot") else {}
                 points = []
                 for key, counts, total in inst.snapshot():
-                    points.append({
+                    point = {
                         "attributes": _attr_list(key),
+                        "startTimeUnixNano": str(self._start_ns),
                         "timeUnixNano": str(_now_ns()),
                         "count": str(sum(counts)),
                         "sum": total,
                         "bucketCounts": [str(c) for c in counts],
                         "explicitBounds": list(inst.buckets),
-                    })
+                    }
+                    exs = [{
+                        "timeUnixNano": str(int(ex[1] * 1e9)),
+                        "asDouble": ex[0],
+                        "traceId": ex[2],
+                        "spanId": ex[3],
+                    } for ex in (exemplars.get(key) or []) if ex]
+                    if exs:
+                        point["exemplars"] = exs
+                    points.append(point)
                 ms.append({"name": inst.name, "description": inst.help,
                            "histogram": {"aggregationTemporality": 2,
                                          "dataPoints": points}})
@@ -104,6 +120,7 @@ class OtlpExporter:
             else:  # counter
                 points = [{
                     "attributes": _attr_list(key),
+                    "startTimeUnixNano": str(self._start_ns),
                     "timeUnixNano": str(_now_ns()),
                     "asDouble": v,
                 } for key, v in inst.snapshot()]

@@ -1,0 +1,366 @@
+"""Self-computed SLOs with multi-window burn-rate evaluation (SRE
+workbook-style fast/slow-window alerting, computed in-process from the
+metrics registry — no external rules engine).
+
+Five SLIs, each reduced to good/total event counts over a sliding
+window so every one of them burns a single error budget:
+
+  * ``upload_acceptance``  — funnel ``validated`` / ``uploaded``
+  * ``prepare_success``    — funnel ``prepare_done`` / ``agg_init``
+  * ``agg_step_latency``   — job steps completing under the latency
+    threshold (``janus_job_step_time_seconds`` buckets)
+  * ``helper_rtt``         — leader->helper round trips under threshold
+    (``janus_helper_rtt_seconds``)
+  * ``device_occupancy``   — device batches above the minimum occupancy
+    (``janus_device_batch_occupancy``)
+
+The engine snapshots the raw cumulative counts (``sample()``), keeps a
+bounded history, and ``evaluate()`` computes each SLI over the fast and
+slow windows: ``burn = error_rate / (1 - objective)`` (burn 1.0 =
+consuming exactly the window's budget).  An SLI alerts only when BOTH
+windows burn above the threshold — the fast window gives detection
+latency, the slow window keeps one spike from paging.  Results are
+exported as ``janus_slo_burn_rate{sli,window}`` and
+``janus_slo_budget_remaining{sli}`` gauges and served at ``/debug/slo``
+(janus_tpu.health).
+
+Env knobs (all optional; see docs/CONFIGURING_SLO.md):
+JANUS_SLO_WINDOW_FAST_S / JANUS_SLO_WINDOW_SLOW_S /
+JANUS_SLO_SAMPLE_INTERVAL_S / JANUS_SLO_BURN_ALERT /
+JANUS_SLO_UPLOAD_ACCEPTANCE / JANUS_SLO_PREPARE_SUCCESS /
+JANUS_SLO_STEP_P99_S / JANUS_SLO_HELPER_RTT_P99_S /
+JANUS_SLO_OCCUPANCY_MIN / JANUS_SLO_OCCUPANCY_RATIO.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from dataclasses import dataclass
+
+from janus_tpu import metrics
+
+slo_burn_rate = metrics.REGISTRY.gauge(
+    "janus_slo_burn_rate",
+    "error-budget burn rate per SLI and window (1.0 = consuming exactly "
+    "the window's budget)")
+slo_budget_remaining = metrics.REGISTRY.gauge(
+    "janus_slo_budget_remaining",
+    "fraction of the slow window's error budget still unspent, per SLI")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+@dataclass(frozen=True)
+class SloObjective:
+    """One SLI's target: `objective` is the good/total ratio to hold
+    (0.99 = 1% error budget); latency/occupancy SLIs additionally carry
+    the threshold that splits good events from bad."""
+
+    sli: str
+    objective: float
+    description: str
+    threshold: float | None = None
+
+
+def default_objectives() -> list[SloObjective]:
+    return [
+        SloObjective(
+            "upload_acceptance",
+            _env_float("JANUS_SLO_UPLOAD_ACCEPTANCE", 0.99),
+            "uploaded reports passing validation (funnel "
+            "validated/uploaded)"),
+        SloObjective(
+            "prepare_success",
+            _env_float("JANUS_SLO_PREPARE_SUCCESS", 0.99),
+            "reports entering aggregation that finish preparation "
+            "(funnel prepare_done/agg_init)"),
+        SloObjective(
+            "agg_step_latency", 0.99,
+            "aggregation/collection job steps completing under the "
+            "latency threshold",
+            threshold=_env_float("JANUS_SLO_STEP_P99_S", 1.0)),
+        SloObjective(
+            "helper_rtt", 0.99,
+            "leader->helper round trips completing under the latency "
+            "threshold",
+            threshold=_env_float("JANUS_SLO_HELPER_RTT_P99_S", 1.0)),
+        SloObjective(
+            "device_occupancy",
+            _env_float("JANUS_SLO_OCCUPANCY_RATIO", 0.9),
+            "device batches launched above the minimum lane occupancy",
+            threshold=_env_float("JANUS_SLO_OCCUPANCY_MIN", 0.2)),
+    ]
+
+
+# -- raw sampling ----------------------------------------------------------
+
+
+def _agg_hist(hist) -> list[int]:
+    """Bucket counts summed across every label set of a Histogram."""
+    total = [0] * (len(hist.buckets) + 1)
+    for _key, counts, _sum in hist.snapshot():
+        for i, c in enumerate(counts):
+            total[i] += c
+    return total
+
+
+def _funnel_stage_totals() -> dict[str, int]:
+    from janus_tpu import funnel
+
+    totals: dict[str, int] = {}
+    for key, v in funnel.reports_total.snapshot():
+        stage = dict(key).get("stage", "?")
+        totals[stage] = totals.get(stage, 0) + int(v)
+    return totals
+
+
+def _raw_sample() -> dict:
+    return {
+        "funnel": _funnel_stage_totals(),
+        "step": _agg_hist(metrics.job_step_time),
+        "rtt": _agg_hist(metrics.helper_rtt_seconds),
+        "occupancy": _agg_hist(metrics.device_batch_occupancy),
+    }
+
+
+def _hist_delta(cur: list[int], ref: list[int]) -> list[int]:
+    ref = ref + [0] * (len(cur) - len(ref))
+    return [max(c - r, 0) for c, r in zip(cur, ref)]
+
+
+def _under_threshold(bounds, counts: list[int], threshold: float) -> int:
+    """Observations in buckets whose upper bound <= threshold (the
+    conservative bucket-resolution reading of 'completed under T')."""
+    k = bisect_left(list(bounds), threshold)
+    if k < len(bounds) and bounds[k] == threshold:
+        k += 1
+    return sum(counts[:k])
+
+
+def _quantile(bounds, counts: list[int], q: float) -> float | None:
+    """Linear-interpolated quantile estimate from bucket counts (the
+    classic histogram_quantile); None with no observations."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for bound, c in zip(bounds, counts):
+        if cum + c >= rank:
+            frac = (rank - cum) / c if c else 0.0
+            return lo + (bound - lo) * frac
+        cum += c
+        lo = bound
+    return float(bounds[-1]) if bounds else None
+
+
+def _good_total(obj: SloObjective, cur: dict, ref: dict) -> tuple[int, int]:
+    if obj.sli == "upload_acceptance":
+        f_cur, f_ref = cur["funnel"], ref["funnel"]
+        total = f_cur.get("uploaded", 0) - f_ref.get("uploaded", 0)
+        good = f_cur.get("validated", 0) - f_ref.get("validated", 0)
+        return min(good, total), total
+    if obj.sli == "prepare_success":
+        f_cur, f_ref = cur["funnel"], ref["funnel"]
+        total = f_cur.get("agg_init", 0) - f_ref.get("agg_init", 0)
+        good = f_cur.get("prepare_done", 0) - f_ref.get("prepare_done", 0)
+        return min(good, total), total
+    if obj.sli == "agg_step_latency":
+        counts = _hist_delta(cur["step"], ref["step"])
+        return (_under_threshold(metrics.job_step_time.buckets, counts,
+                                 obj.threshold), sum(counts))
+    if obj.sli == "helper_rtt":
+        counts = _hist_delta(cur["rtt"], ref["rtt"])
+        return (_under_threshold(metrics.helper_rtt_seconds.buckets, counts,
+                                 obj.threshold), sum(counts))
+    if obj.sli == "device_occupancy":
+        counts = _hist_delta(cur["occupancy"], ref["occupancy"])
+        total = sum(counts)
+        bad = _under_threshold(metrics.device_batch_occupancy.buckets,
+                               counts, obj.threshold)
+        return total - bad, total
+    raise ValueError(f"unknown SLI {obj.sli!r}")
+
+
+# -- the engine ------------------------------------------------------------
+
+
+class SloEngine:
+    def __init__(self, objectives: list[SloObjective] | None = None,
+                 fast_window_s: float | None = None,
+                 slow_window_s: float | None = None,
+                 burn_alert: float | None = None,
+                 time_fn=time.time):
+        self.objectives = objectives or default_objectives()
+        self.fast_window = fast_window_s if fast_window_s is not None \
+            else _env_float("JANUS_SLO_WINDOW_FAST_S", 300.0)
+        self.slow_window = slow_window_s if slow_window_s is not None \
+            else _env_float("JANUS_SLO_WINDOW_SLOW_S", 3600.0)
+        self.burn_alert = burn_alert if burn_alert is not None \
+            else _env_float("JANUS_SLO_BURN_ALERT", 2.0)
+        self._time = time_fn
+        self._samples: deque = deque()  # (ts, raw)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sample(self) -> None:
+        """Record one cumulative snapshot; prunes history past the slow
+        window (plus slack for edge alignment)."""
+        now = self._time()
+        raw = _raw_sample()
+        with self._lock:
+            self._samples.append((now, raw))
+            horizon = now - self.slow_window * 1.25
+            while len(self._samples) > 2 and self._samples[1][0] <= horizon:
+                self._samples.popleft()
+
+    def _reference(self, now: float, window: float):
+        """The stored sample nearest (now - window) — prefers the newest
+        sample at or before the window edge so the delta spans at least
+        the window; falls back to the oldest sample available."""
+        edge = now - window
+        with self._lock:
+            samples = list(self._samples)
+        if not samples:
+            return None
+        best = samples[0]
+        for ts, raw in samples:
+            if ts <= edge:
+                best = (ts, raw)
+            else:
+                break
+        return best
+
+    def evaluate(self) -> dict:
+        """Compute every SLI over both windows against a fresh sample,
+        update the SLO gauges, and return the /debug/slo payload."""
+        now = self._time()
+        cur = _raw_sample()
+        with self._lock:
+            if not self._samples:
+                self._samples.append((now, cur))
+        report: dict = {
+            "windows": {"fast_s": self.fast_window,
+                        "slow_s": self.slow_window},
+            "burn_alert_threshold": self.burn_alert,
+            "slos": {},
+        }
+        for obj in self.objectives:
+            budget = 1.0 - obj.objective
+            entry: dict = {
+                "objective": obj.objective,
+                "description": obj.description,
+                "windows": {},
+            }
+            if obj.threshold is not None:
+                entry["threshold"] = obj.threshold
+            burns: dict[str, float | None] = {}
+            for wname, wlen in (("fast", self.fast_window),
+                                ("slow", self.slow_window)):
+                ref = self._reference(now, wlen)
+                ref_raw = ref[1] if ref else cur
+                span = now - ref[0] if ref else 0.0
+                good, total = _good_total(obj, cur, ref_raw)
+                if total <= 0:
+                    ratio = error_rate = burn = None
+                else:
+                    ratio = good / total
+                    error_rate = 1.0 - ratio
+                    burn = error_rate / budget if budget > 0 else 0.0
+                burns[wname] = burn
+                entry["windows"][wname] = {
+                    "span_s": round(span, 1),
+                    "good": good, "total": total,
+                    "ratio": None if ratio is None else round(ratio, 6),
+                    "burn_rate": None if burn is None else round(burn, 3),
+                }
+                slo_burn_rate.set(0.0 if burn is None else burn,
+                                  sli=obj.sli, window=wname)
+            slow = entry["windows"]["slow"]
+            if slow["total"]:
+                spent = (slow["total"] - slow["good"]) / (
+                    slow["total"] * budget) if budget > 0 else 0.0
+                remaining = max(0.0, 1.0 - spent)
+            else:
+                remaining = 1.0
+            entry["budget_remaining"] = round(remaining, 4)
+            slo_budget_remaining.set(remaining, sli=obj.sli)
+            entry["alerting"] = bool(
+                burns["fast"] is not None and burns["slow"] is not None
+                and burns["fast"] >= self.burn_alert
+                and burns["slow"] >= self.burn_alert)
+            report["slos"][obj.sli] = entry
+        report["alerting"] = sorted(
+            sli for sli, e in report["slos"].items() if e["alerting"])
+        # latency quantile estimates over the fast window, for operators
+        ref = self._reference(now, self.fast_window)
+        ref_raw = ref[1] if ref else cur
+        report["p99_estimates"] = {
+            "agg_step_latency_s": _quantile(
+                metrics.job_step_time.buckets,
+                _hist_delta(cur["step"], ref_raw["step"]), 0.99),
+            "helper_rtt_s": _quantile(
+                metrics.helper_rtt_seconds.buckets,
+                _hist_delta(cur["rtt"], ref_raw["rtt"]), 0.99),
+        }
+        return report
+
+    # -- background sampling ----------------------------------------------
+
+    def _run(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.sample()
+                self.evaluate()
+            except Exception:
+                pass  # the SLO engine must never take the process down
+
+    def start(self, interval_s: float | None = None) -> "SloEngine":
+        if interval_s is None:
+            interval_s = _env_float("JANUS_SLO_SAMPLE_INTERVAL_S", 15.0)
+        self.sample()
+        self._thread = threading.Thread(
+            target=self._run, args=(interval_s,), daemon=True,
+            name="slo-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_engine: SloEngine | None = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> SloEngine:
+    """The process-global engine (created lazily, not auto-started; the
+    /debug/slo endpoint samples + evaluates on demand)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = SloEngine()
+        return _engine
+
+
+def set_engine(engine: SloEngine | None) -> None:
+    """Swap the process-global engine (tests, custom objectives)."""
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.stop()
+        _engine = engine

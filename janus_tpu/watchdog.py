@@ -1,0 +1,296 @@
+"""Stall watchdog: a background liveness check over the moving parts
+that can silently wedge under load.
+
+Four detectors, each with a configurable deadline/threshold:
+
+  * **frozen jobs** — an aggregation/collection job was leased
+    (``job_leased``) but hasn't completed a step (``job_progress`` /
+    ``job_done``) within JANUS_WATCHDOG_JOB_DEADLINE_S.  The stall
+    record carries the trace id captured at lease time, so the verdict
+    links straight to the job's spans and flight-recorder entries.
+  * **dead upload dispatcher** — the UploadPipeline has queued waiters
+    but no live dispatcher thread, or the oldest waiter has been parked
+    past JANUS_WATCHDOG_DISPATCH_DEADLINE_S (``queue_stats()``).
+  * **saturated write queue** — a ReportWriteBatcher's pending buffer
+    exceeds JANUS_WATCHDOG_QUEUE_DEPTH (``pending_count()``): flushes
+    are not keeping up with validation.
+  * **compile storm** — ``janus_device_batch_compiles`` grew by more
+    than JANUS_WATCHDOG_COMPILE_STORM between two checks: the device
+    engine is recompiling instead of reusing cached kernels (a batch
+    bucketing or cache-key regression).
+
+Every NEW stall emits a ``watchdog_stall`` flight-recorder event and
+bumps ``janus_watchdog_stalls_total{kind}``; a stall is re-reported only
+after it clears and recurs.  ``check_now()`` runs the detectors on
+demand (the /debug/watchdog endpoint in janus_tpu.health calls it per
+request, so tests never need the thread); ``start()`` runs them every
+JANUS_WATCHDOG_INTERVAL_S in a daemon thread.  Like every observability
+hook here, the watchdog must never take the data plane down.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from janus_tpu import flight_recorder, metrics
+
+watchdog_stalls_total = metrics.REGISTRY.counter(
+    "janus_watchdog_stalls_total",
+    "stalls detected by the watchdog, by kind (job_stall/dead_dispatcher/"
+    "write_queue_saturated/compile_storm)")
+watchdog_checks_total = metrics.REGISTRY.counter(
+    "janus_watchdog_checks_total", "watchdog detector sweeps executed")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class Watchdog:
+    def __init__(self, job_deadline_s: float | None = None,
+                 dispatch_deadline_s: float | None = None,
+                 queue_depth_limit: int | None = None,
+                 compile_storm_limit: int | None = None,
+                 time_fn=time.monotonic):
+        self.job_deadline = job_deadline_s if job_deadline_s is not None \
+            else _env_float("JANUS_WATCHDOG_JOB_DEADLINE_S", 120.0)
+        self.dispatch_deadline = dispatch_deadline_s \
+            if dispatch_deadline_s is not None \
+            else _env_float("JANUS_WATCHDOG_DISPATCH_DEADLINE_S", 5.0)
+        self.queue_depth_limit = queue_depth_limit \
+            if queue_depth_limit is not None \
+            else int(_env_float("JANUS_WATCHDOG_QUEUE_DEPTH", 4096))
+        self.compile_storm_limit = compile_storm_limit \
+            if compile_storm_limit is not None \
+            else int(_env_float("JANUS_WATCHDOG_COMPILE_STORM", 8))
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._jobs: dict[tuple[str, str], dict] = {}
+        self._pipelines: list = []
+        self._writers: list = []
+        self._last_compiles: int | None = None
+        self._reported: set = set()  # stall keys already reported, uncleared
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- hooks (called from the data plane; must stay cheap) ---------------
+
+    def job_leased(self, kind: str, job_id, task_id=None) -> None:
+        """A job driver took a lease; the active trace context (the
+        driver's step span) is captured for the eventual stall record."""
+        try:
+            from janus_tpu import trace
+
+            ctx = trace.current_context()
+            with self._lock:
+                self._jobs[(kind, str(job_id))] = {
+                    "leased_at": self._time(),
+                    "task_id": str(task_id) if task_id is not None else None,
+                    "trace_id": ctx.trace_id if ctx is not None else None,
+                }
+        except Exception:
+            pass
+
+    def job_progress(self, kind: str, job_id) -> None:
+        """Heartbeat: the job completed a step; its deadline restarts."""
+        try:
+            with self._lock:
+                entry = self._jobs.get((kind, str(job_id)))
+                if entry is not None:
+                    entry["leased_at"] = self._time()
+        except Exception:
+            pass
+
+    def job_done(self, kind: str, job_id) -> None:
+        try:
+            with self._lock:
+                self._jobs.pop((kind, str(job_id)), None)
+                self._reported.discard(("job_stall", kind, str(job_id)))
+        except Exception:
+            pass
+
+    def register_upload_pipeline(self, pipeline) -> None:
+        """Watch an UploadPipeline (anything with ``queue_stats()``)."""
+        with self._lock:
+            if pipeline not in self._pipelines:
+                self._pipelines.append(pipeline)
+
+    def register_report_writer(self, writer) -> None:
+        """Watch a ReportWriteBatcher (anything with ``pending_count()``)."""
+        with self._lock:
+            if writer not in self._writers:
+                self._writers.append(writer)
+
+    def unregister(self, obj) -> None:
+        with self._lock:
+            if obj in self._pipelines:
+                self._pipelines.remove(obj)
+            if obj in self._writers:
+                self._writers.remove(obj)
+
+    # -- detectors ---------------------------------------------------------
+
+    def check_now(self) -> dict:
+        """Run every detector once; returns the /debug/watchdog verdict."""
+        watchdog_checks_total.add(1)
+        now = self._time()
+        stalls: list[dict] = []
+        with self._lock:
+            jobs = dict(self._jobs)
+            pipelines = list(self._pipelines)
+            writers = list(self._writers)
+
+        for (kind, job_id), entry in jobs.items():
+            age = now - entry["leased_at"]
+            if age > self.job_deadline:
+                stalls.append({
+                    "kind": "job_stall", "job_kind": kind, "job_id": job_id,
+                    "task_id": entry["task_id"],
+                    "trace_id": entry["trace_id"],
+                    "age_s": round(age, 3),
+                    "deadline_s": self.job_deadline,
+                    "key": ("job_stall", kind, job_id),
+                })
+
+        for i, pipeline in enumerate(pipelines):
+            try:
+                stats = pipeline.queue_stats()
+            except Exception:
+                continue
+            queued = stats.get("queued", 0)
+            if not queued:
+                continue
+            alive = stats.get("dispatcher_alive", False)
+            wait = stats.get("oldest_wait_s", 0.0)
+            if not alive or wait > self.dispatch_deadline:
+                stalls.append({
+                    "kind": "dead_dispatcher", "pipeline": i,
+                    "queued": queued, "dispatcher_alive": alive,
+                    "oldest_wait_s": round(wait, 3),
+                    "deadline_s": self.dispatch_deadline,
+                    "key": ("dead_dispatcher", i),
+                })
+
+        for i, writer in enumerate(writers):
+            try:
+                pending = writer.pending_count()
+            except Exception:
+                continue
+            if pending > self.queue_depth_limit:
+                stalls.append({
+                    "kind": "write_queue_saturated", "writer": i,
+                    "pending": pending, "limit": self.queue_depth_limit,
+                    "key": ("write_queue_saturated", i),
+                })
+
+        compiles = sum(
+            int(v) for _k, v in metrics.device_batch_compiles.snapshot())
+        with self._lock:
+            last = self._last_compiles
+            self._last_compiles = compiles
+        if last is not None and compiles - last > self.compile_storm_limit:
+            stalls.append({
+                "kind": "compile_storm", "compiles": compiles - last,
+                "limit": self.compile_storm_limit,
+                "key": ("compile_storm",),
+            })
+
+        # report each stall once per episode: flight-recorder event +
+        # counter on first sighting, silence until it clears
+        current_keys = set()
+        for stall in stalls:
+            key = stall.pop("key")
+            current_keys.add(key)
+            with self._lock:
+                fresh = key not in self._reported
+                if fresh:
+                    self._reported.add(key)
+            if fresh:
+                watchdog_stalls_total.add(1, kind=stall["kind"])
+                fields = {k: v for k, v in stall.items()
+                          if v is not None and k not in ("kind", "task_id",
+                                                         "job_id")}
+                flight_recorder.record(
+                    "watchdog_stall", task_id=stall.get("task_id"),
+                    job_id=stall.get("job_id"), stall=stall["kind"],
+                    **fields)
+        with self._lock:
+            self._reported &= current_keys
+
+        return {
+            "ok": not stalls,
+            "stalls": stalls,
+            "watched": {"jobs": len(jobs), "pipelines": len(pipelines),
+                        "writers": len(writers)},
+            "thresholds": {
+                "job_deadline_s": self.job_deadline,
+                "dispatch_deadline_s": self.dispatch_deadline,
+                "queue_depth_limit": self.queue_depth_limit,
+                "compile_storm_limit": self.compile_storm_limit,
+            },
+        }
+
+    # -- background sweep --------------------------------------------------
+
+    def _run(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.check_now()
+            except Exception:
+                pass  # the watchdog must never take the process down
+
+    def start(self, interval_s: float | None = None) -> "Watchdog":
+        if interval_s is None:
+            interval_s = _env_float("JANUS_WATCHDOG_INTERVAL_S", 15.0)
+        self._thread = threading.Thread(
+            target=self._run, args=(interval_s,), daemon=True,
+            name="stall-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def clear(self) -> None:
+        """Forget all tracked state (tests)."""
+        with self._lock:
+            self._jobs.clear()
+            self._pipelines.clear()
+            self._writers.clear()
+            self._reported.clear()
+            self._last_compiles = None
+
+
+WATCHDOG = Watchdog()
+
+
+def job_leased(kind: str, job_id, task_id=None) -> None:
+    WATCHDOG.job_leased(kind, job_id, task_id=task_id)
+
+
+def job_progress(kind: str, job_id) -> None:
+    WATCHDOG.job_progress(kind, job_id)
+
+
+def job_done(kind: str, job_id) -> None:
+    WATCHDOG.job_done(kind, job_id)
+
+
+def register_upload_pipeline(pipeline) -> None:
+    WATCHDOG.register_upload_pipeline(pipeline)
+
+
+def register_report_writer(writer) -> None:
+    WATCHDOG.register_report_writer(writer)
+
+
+def check_now() -> dict:
+    return WATCHDOG.check_now()

@@ -73,6 +73,81 @@ def test_exposition_grammar_lint_smoke():
     assert lint_exposition('# HELP x h\n# TYPE x counter\nx{a="b} 1\n') != []
 
 
+def test_instrument_hygiene_lint():
+    """Every live instrument carries help text, the janus_ namespace
+    prefix, and bounded label-set cardinality; the full live exposition
+    (which now includes funnel/SLO/watchdog instruments) still parses
+    under the text-format grammar."""
+    from janus_tpu import funnel, slo, watchdog  # noqa: F401  (register)
+    from janus_tpu.metrics import (all_instruments, lint_exposition,
+                                   lint_instruments)
+
+    problems = lint_instruments(all_instruments())
+    assert problems == [], problems
+    names = {i.name for i in all_instruments()}
+    for expected in ("janus_funnel_reports_total", "janus_slo_burn_rate",
+                     "janus_slo_budget_remaining",
+                     "janus_watchdog_stalls_total",
+                     "janus_helper_rtt_seconds"):
+        assert expected in names
+    errors = lint_exposition(REGISTRY.exposition())
+    assert errors == [], errors
+
+    # ...and the lint actually catches each hygiene violation
+    bad = Registry()
+    bad.counter("unprefixed_total", "has help")
+    bad.counter("janus_no_help_total")
+    wide = bad.counter("janus_wide_total", "label explosion")
+    for i in range(20):
+        wide.add(1, report_id=str(i))
+    problems = lint_instruments(bad.all(), max_label_sets=10)
+    assert any("missing 'janus_' prefix" in p for p in problems)
+    assert any("missing help text" in p for p in problems)
+    assert any("cardinality threshold" in p for p in problems)
+    # test fixtures are allowed to skip the prefix check
+    ok = Registry()
+    ok.counter("test_fixture_total", "help")
+    assert lint_instruments(ok.all()) == []
+
+
+def test_openmetrics_exposition_exemplars_and_eof():
+    """exposition(openmetrics=True) appends trace exemplars to histogram
+    bucket samples and terminates with # EOF; the default exposition
+    stays strict Prometheus text and lints clean."""
+    from janus_tpu import trace
+    from janus_tpu.metrics import lint_exposition
+
+    reg = Registry()
+    h = reg.histogram("test_om_seconds", "om", buckets=(0.1, 1.0))
+    h.observe(0.05)  # untraced: no exemplar on this bucket
+    with trace.span("om test"):
+        ctx = trace.current_context()
+        h.observe(0.5)
+    om = reg.exposition(openmetrics=True)
+    assert om.rstrip("\n").endswith("# EOF")
+    line = next(l for l in om.splitlines()
+                if l.startswith('test_om_seconds_bucket{le="1.0"}'))
+    assert f'# {{trace_id="{ctx.trace_id}",span_id="{ctx.span_id}"}} 0.5' \
+        in line
+    assert 'le="0.1"} 1\n' in om  # the untraced bucket has no exemplar
+
+    plain = reg.exposition()
+    assert "# EOF" not in plain and " # {" not in plain
+    assert lint_exposition(plain) == []
+
+
+def test_exemplar_capture_kill_switch(monkeypatch):
+    from janus_tpu import trace
+
+    monkeypatch.setenv("JANUS_METRICS_EXEMPLARS", "0")
+    reg = Registry()
+    h = reg.histogram("test_om_off_seconds", "om", buckets=(1.0,))
+    with trace.span("om off"):
+        h.observe(0.5)
+    assert h.exemplars_snapshot() == []
+    assert " # {" not in reg.exposition(openmetrics=True)
+
+
 def test_health_server_serves_metrics():
     REGISTRY.counter("test_health_hits", "x").add(1)
     server = HealthServer().start()

@@ -100,6 +100,74 @@ def test_role_resource_attribute_and_gauge_export():
         srv.shutdown()
 
 
+def _metric(payload, name):
+    return next(m for rm in payload["resourceMetrics"]
+                for sm in rm["scopeMetrics"] for m in sm["metrics"]
+                if m["name"] == name)
+
+
+def test_cumulative_points_carry_constant_start_time():
+    """Cumulative-temporality sums and histograms need a constant series
+    start time: startTimeUnixNano is present on every point and identical
+    across flushes from the same exporter."""
+    _Capture.received = []
+    srv, endpoint = _server()
+    try:
+        c = metrics.REGISTRY.counter("janus_otlp_test_start_counter", "t")
+        h = metrics.REGISTRY.histogram("janus_otlp_test_start_hist", "t")
+        c.add(1)
+        h.observe(0.1)
+        exp = OtlpExporter(OtlpConfig(endpoint=endpoint, interval_s=3600))
+        exp.flush()
+        c.add(1)
+        h.observe(0.2)
+        exp.flush()
+        payloads = [b for p, b in _Capture.received if p == "/v1/metrics"]
+        assert len(payloads) == 2
+        starts = set()
+        for payload in payloads:
+            spt = _metric(payload, "janus_otlp_test_start_counter")[
+                "sum"]["dataPoints"][0]
+            hpt = _metric(payload, "janus_otlp_test_start_hist")[
+                "histogram"]["dataPoints"][0]
+            for pt in (spt, hpt):
+                assert "startTimeUnixNano" in pt
+                assert int(pt["startTimeUnixNano"]) <= int(pt["timeUnixNano"])
+                starts.add(pt["startTimeUnixNano"])
+        assert len(starts) == 1, f"start time drifted: {starts}"
+        # a second exporter is a new series start
+        exp2 = OtlpExporter(OtlpConfig(endpoint=endpoint, interval_s=3600))
+        assert exp2._start_ns >= int(next(iter(starts)))
+    finally:
+        srv.shutdown()
+
+
+def test_histogram_data_points_carry_trace_exemplars():
+    """A traced observation lands on the OTLP histogram dataPoint as an
+    exemplar with the observing span's trace/span ids."""
+    _Capture.received = []
+    srv, endpoint = _server()
+    try:
+        h = metrics.REGISTRY.histogram("janus_otlp_test_exemplar_hist", "t",
+                                       buckets=(1.0,))
+        with trace.span("otlp exemplar span"):
+            ctx = trace.current_context()
+            h.observe(0.5, kind="e")
+        exp = OtlpExporter(OtlpConfig(endpoint=endpoint, interval_s=3600))
+        exp.flush()
+        payload = next(b for p, b in _Capture.received if p == "/v1/metrics")
+        pt = _metric(payload, "janus_otlp_test_exemplar_hist")[
+            "histogram"]["dataPoints"][0]
+        assert "exemplars" in pt, pt
+        ex = pt["exemplars"][0]
+        assert ex["traceId"] == ctx.trace_id
+        assert ex["spanId"] == ctx.span_id
+        assert ex["asDouble"] == 0.5
+        assert int(ex["timeUnixNano"]) > 0
+    finally:
+        srv.shutdown()
+
+
 def test_export_failure_is_swallowed():
     exp = OtlpExporter(OtlpConfig(endpoint="http://127.0.0.1:9",  # closed
                                   interval_s=3600))

@@ -1,0 +1,469 @@
+"""Runtime-health subsystem end to end: the report-lifecycle funnel
+(janus_tpu/funnel.py), the SLO burn-rate engine (janus_tpu/slo.py), the
+stall watchdog (janus_tpu/watchdog.py), and trace exemplars — including
+the cross-subsystem linkage story: a report is traceable through every
+funnel stage at /debug/funnel, an upload-phase histogram exemplar's
+trace id matches the flight-recorder record for the same batch, and
+injected stalls surface at /debug/watchdog carrying the stalled job's
+trace id."""
+
+import re
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import requests
+
+from janus_tpu import flight_recorder, funnel, metrics, trace, watchdog
+from janus_tpu.health import HealthServer
+from janus_tpu.slo import SloEngine, SloObjective, set_engine
+from janus_tpu.watchdog import WATCHDOG, Watchdog, watchdog_stalls_total
+
+
+# -- funnel ----------------------------------------------------------------
+
+
+def test_funnel_stage_accounting_and_loss():
+    funnel.clear()
+    funnel.count("uploaded", "t1", 10)
+    funnel.count("validated", "t1", 8)
+    funnel.count("stored", "t1", 8)
+    funnel.reject("t1", "decrypt_failure", 2)
+    funnel.count("agg_init", "t1", 8, role="helper")
+    funnel.count("uploaded", "t1", 0)  # no-op
+    snap = funnel.snapshot()["t1"]
+    leader = snap["leader"]
+    assert leader["stages"] == {"uploaded": 10, "validated": 8, "stored": 8}
+    assert leader["loss"] == {"validated": 2, "stored": 0}
+    assert leader["rejected"] == {"decrypt_failure": 2}
+    assert leader["rejected_total"] == 2
+    # the helper's ledger is separate
+    assert snap["helper"]["stages"] == {"agg_init": 8}
+    # accounting must never raise, whatever the reason object is
+    funnel.reject("t1", None)
+    funnel.count("uploaded", object())
+
+
+def test_funnel_end_to_end_report_traceable_through_all_stages():
+    """A real leader+helper pair: uploaded reports are traceable through
+    uploaded -> validated -> stored -> agg_init -> prepare_done ->
+    collected on the leader (and the helper's ledger tracks its own
+    stages), then served at /debug/funnel."""
+    from janus_tpu.aggregator import Aggregator, AggregatorConfig, DapHttpServer
+    from janus_tpu.aggregator.aggregation_job_creator import AggregationJobCreator
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import ephemeral_datastore
+    from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+    from janus_tpu.messages import Interval, Query, Time
+    from janus_tpu.models import VdafInstance
+
+    funnel.clear()
+    measurements = [1, 0, 1]
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          VdafInstance.prio3_count())
+    builder.with_min_batch_size(len(measurements))
+    clock = MockClock(Time(1_700_000_000))
+    helper_ds, leader_ds = ephemeral_datastore(clock), ephemeral_datastore(clock)
+    helper_agg = Aggregator(helper_ds, clock,
+                            AggregatorConfig(batch_aggregation_shard_count=2))
+    leader_agg = Aggregator(leader_ds, clock,
+                            AggregatorConfig(batch_aggregation_shard_count=2))
+    hs, ls = DapHttpServer(helper_agg).start(), DapHttpServer(leader_agg).start()
+    try:
+        builder.helper_endpoint = hs.address
+        builder.leader_endpoint = ls.address
+        helper_ds.run_tx("p", lambda tx: tx.put_aggregator_task(
+            builder.helper_view()))
+        leader_ds.run_tx("p", lambda tx: tx.put_aggregator_task(
+            builder.leader_view()))
+        client = Client(
+            ClientParameters(builder.task_id, ls.address, hs.address,
+                             builder.time_precision),
+            VdafInstance.prio3_count(), clock=clock)
+        for meas in measurements:
+            client.upload(meas)
+        leader_agg.report_writer.flush()
+        assert AggregationJobCreator(
+            leader_ds, 1, 10, batch_aggregation_shard_count=2).run_once() == 1
+        drv = AggregationJobDriver(leader_ds, batch_aggregation_shard_count=2)
+        assert JobDriver(JobDriverConfig(), drv.acquirer,
+                         drv.stepper).run_once() == 1
+
+        collector = Collector(builder.task_id, ls.address,
+                              builder.collector_auth_token,
+                              builder.collector_keypair,
+                              VdafInstance.prio3_count())
+        interval = Interval(clock.now().round_down(builder.time_precision),
+                            builder.time_precision)
+        query = Query.time_interval(interval)
+        job_id = collector.start_collection(query)
+        cdrv = CollectionJobDriver(leader_ds)
+        assert JobDriver(JobDriverConfig(), cdrv.acquirer,
+                         cdrv.stepper).run_once() == 1
+        assert collector.poll_once(job_id, query).report_count == 3
+
+        n = len(measurements)
+        tid = str(builder.task_id)
+        snap = funnel.snapshot()[tid]
+        leader = snap["leader"]
+        for stage in funnel.STAGES:
+            assert leader["stages"].get(stage) == n, (stage, leader)
+        assert all(v == 0 for v in leader["loss"].values()), leader["loss"]
+        # the helper process counted its own side of the protocol
+        helper = snap["helper"]
+        assert helper["stages"].get("agg_init") == n
+        assert helper["stages"].get("prepare_done") == n
+        assert helper["stages"].get("collected") == n
+
+        # ...and the same view is served at /debug/funnel
+        server = HealthServer(debug_console=True).start()
+        try:
+            r = requests.get(f"{server.address}/debug/funnel", timeout=5)
+            assert r.status_code == 200
+            body = r.json()
+            assert body["stages"] == list(funnel.STAGES)
+            assert body["tasks"][tid]["leader"]["stages"]["collected"] == n
+            # task_id filter keeps only the asked-for ledger
+            r = requests.get(f"{server.address}/debug/funnel?task_id=nope",
+                             timeout=5)
+            assert r.json()["tasks"] == {}
+        finally:
+            server.stop()
+    finally:
+        hs.stop()
+        ls.stop()
+
+
+# -- exemplars -------------------------------------------------------------
+
+
+_EXEMPLAR_RE = re.compile(
+    r'janus_upload_phase_seconds_bucket\{[^}]*\} \d+ '
+    r'# \{trace_id="([0-9a-f]{32})",span_id="[0-9a-f]{16}"\}')
+
+
+def test_upload_exemplar_trace_id_matches_flight_recorder_batch():
+    """The linkage demo: a coalesced upload burst leaves (a) trace
+    exemplars on the janus_upload_phase_seconds buckets in the
+    OpenMetrics exposition and (b) an upload_batch flight-recorder event
+    — with the SAME trace id, because both are captured inside the
+    pipeline's `upload batch` span."""
+    from janus_tpu.aggregator import Aggregator, AggregatorConfig
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import ephemeral_datastore
+    from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+    from janus_tpu.messages import Time
+    from janus_tpu.models import VdafInstance
+
+    flight_recorder.clear()
+    builder = TaskBuilder(QueryTypeCfg.time_interval(),
+                          VdafInstance.prio3_count())
+    clock = MockClock(Time(1_700_000_000))
+    ds = ephemeral_datastore(clock)
+    task = builder.leader_view()
+    ds.run_tx("p", lambda tx: tx.put_aggregator_task(task))
+    agg = Aggregator(ds, clock, AggregatorConfig(
+        max_upload_batch_size=64, upload_coalesce_enabled=True))
+    client = Client(
+        ClientParameters(builder.task_id, "http://l.invalid",
+                         "http://h.invalid", builder.time_precision),
+        VdafInstance.prio3_count(),
+        leader_hpke_config=builder.leader_hpke_keypair.config,
+        helper_hpke_config=builder.helper_hpke_keypair.config, clock=clock)
+    bodies = [client.prepare_report(i % 2, time=clock.now()).encode()
+              for i in range(32)]
+    with ThreadPoolExecutor(16) as pool:
+        list(pool.map(lambda b: agg.handle_upload(task.task_id, b), bodies))
+    agg.shutdown()
+
+    server = HealthServer().start()
+    try:
+        # default scrape: strict Prometheus text, no exemplars, lints clean
+        plain = requests.get(f"{server.address}/metrics", timeout=5)
+        assert plain.headers["Content-Type"].startswith("text/plain")
+        assert " # {" not in plain.text
+        assert metrics.lint_exposition(plain.text) == []
+        # negotiated scrape: OpenMetrics with exemplars and # EOF
+        om = requests.get(
+            f"{server.address}/metrics",
+            headers={"Accept": "application/openmetrics-text"}, timeout=5)
+        assert om.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        assert om.text.rstrip("\n").endswith("# EOF")
+        exemplar_ids = set(_EXEMPLAR_RE.findall(om.text))
+        assert exemplar_ids, "no upload-phase exemplars in the exposition"
+    finally:
+        server.stop()
+
+    batch_ids = {e["trace_id"]
+                 for e in flight_recorder.snapshot(event="upload_batch")
+                 if e.get("trace_id")}
+    assert batch_ids, "no upload_batch flight-recorder events"
+    # this burst's exemplars resolve to recorded batches (buckets only
+    # touched by earlier tests may keep older trace ids: an exemplar is
+    # the LAST traced observation per bucket)
+    assert exemplar_ids & batch_ids, (exemplar_ids, batch_ids)
+
+
+# -- stall watchdog --------------------------------------------------------
+
+
+def test_watchdog_flags_frozen_job_with_trace_id_within_deadline():
+    """A leased-but-unstepped job is flagged once its age passes the
+    deadline; the stall (and its watchdog_stall flight event) carries the
+    trace id captured at lease time, and the stall counter increments
+    exactly once per episode."""
+    flight_recorder.clear()
+    t = [100.0]
+    wd = Watchdog(job_deadline_s=30, dispatch_deadline_s=5,
+                  queue_depth_limit=100, compile_storm_limit=10_000,
+                  time_fn=lambda: t[0])
+    with trace.span("aggregation job step", job_id="j1"):
+        leased_trace = trace.current_context().trace_id
+        wd.job_leased("aggregation", "j1", task_id="tsk")
+    assert wd.check_now()["ok"]  # fresh lease: not stalled yet
+
+    t[0] += 31.0
+    before = watchdog_stalls_total.value(kind="job_stall")
+    verdict = wd.check_now()
+    assert not verdict["ok"]
+    stall = verdict["stalls"][0]
+    assert stall["kind"] == "job_stall"
+    assert stall["job_id"] == "j1" and stall["task_id"] == "tsk"
+    assert stall["age_s"] > 30 and stall["deadline_s"] == 30
+    assert stall["trace_id"] == leased_trace
+    assert watchdog_stalls_total.value(kind="job_stall") == before + 1
+    events = flight_recorder.snapshot(event="watchdog_stall")
+    assert len(events) == 1
+    assert events[0]["trace_id"] == leased_trace
+    assert events[0]["job_id"] == "j1" and events[0]["stall"] == "job_stall"
+
+    # still stalled: listed again but NOT re-counted / re-recorded
+    verdict = wd.check_now()
+    assert not verdict["ok"]
+    assert watchdog_stalls_total.value(kind="job_stall") == before + 1
+    assert len(flight_recorder.snapshot(event="watchdog_stall")) == 1
+
+    # progress heartbeat clears the episode; a recurrence re-reports
+    wd.job_progress("aggregation", "j1")
+    assert wd.check_now()["ok"]
+    t[0] += 31.0
+    assert not wd.check_now()["ok"]
+    assert watchdog_stalls_total.value(kind="job_stall") == before + 2
+    wd.job_done("aggregation", "j1")
+    assert wd.check_now()["ok"]
+
+
+def test_watchdog_injected_stalls_all_detected_at_debug_endpoint():
+    """The three remaining injections against the PROCESS-global watchdog
+    (what /debug/watchdog actually serves): a killed upload dispatcher
+    (queued waiter, no dispatcher thread), a saturated write queue, and a
+    frozen leased job."""
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.aggregator.upload_pipeline import (UploadPipeline,
+                                                      _PendingUpload)
+
+    # a real pipeline whose dispatcher died before draining the queue
+    pipeline = UploadPipeline(aggregator=None)
+    pipeline._queue.append(_PendingUpload(None, None))
+    stats = pipeline.queue_stats()
+    assert stats["queued"] == 1 and stats["dispatcher_alive"] is False
+    # a real write batcher with more pending work than the (lowered) limit
+    batcher = ReportWriteBatcher(None, max_batch_size=10_000,
+                                 max_batch_write_delay_ms=600_000)
+    watchdog.register_report_writer(batcher)
+    batcher.write_upload_batch([(None, None, None)] * 5, [])
+    assert batcher.pending_count() == 5
+
+    saved = (WATCHDOG.job_deadline, WATCHDOG.queue_depth_limit)
+    server = HealthServer(debug_console=True).start()
+    try:
+        WATCHDOG.queue_depth_limit = 3
+        WATCHDOG.job_deadline = 0.0
+        with trace.span("collection job step"):
+            watchdog.job_leased("collection", "frozen-1", task_id="tsk")
+        time.sleep(0.01)
+
+        r = requests.get(f"{server.address}/debug/watchdog", timeout=5)
+        assert r.status_code == 200
+        verdict = r.json()
+        assert verdict["ok"] is False
+        kinds = {s["kind"] for s in verdict["stalls"]}
+        assert {"job_stall", "dead_dispatcher",
+                "write_queue_saturated"} <= kinds, verdict["stalls"]
+        dead = next(s for s in verdict["stalls"]
+                    if s["kind"] == "dead_dispatcher")
+        assert dead["queued"] == 1 and dead["dispatcher_alive"] is False
+        sat = next(s for s in verdict["stalls"]
+                   if s["kind"] == "write_queue_saturated")
+        assert sat["pending"] == 5 and sat["limit"] == 3
+        frozen = next(s for s in verdict["stalls"] if s["kind"] == "job_stall")
+        assert frozen["job_id"] == "frozen-1" and frozen["trace_id"]
+    finally:
+        server.stop()
+        WATCHDOG.job_deadline, WATCHDOG.queue_depth_limit = saved
+        WATCHDOG.job_done("collection", "frozen-1")
+        WATCHDOG.unregister(pipeline)
+        WATCHDOG.unregister(batcher)
+        with batcher._lock:
+            batcher._drain_locked()  # cancel the flush timer
+
+
+def test_watchdog_compile_storm_detector():
+    t = [0.0]
+    wd = Watchdog(job_deadline_s=1000, dispatch_deadline_s=1000,
+                  queue_depth_limit=10**9, compile_storm_limit=3,
+                  time_fn=lambda: t[0])
+    assert wd.check_now()["ok"]  # establishes the compile baseline
+    metrics.device_batch_compiles.add(5, kind="wd_test", bucket="64")
+    verdict = wd.check_now()
+    assert [s["kind"] for s in verdict["stalls"]] == ["compile_storm"]
+    assert verdict["stalls"][0]["compiles"] == 5
+    assert wd.check_now()["ok"]  # growth stopped: storm over
+
+
+# -- SLO engine ------------------------------------------------------------
+
+
+def test_slo_burn_rates_budget_and_multiwindow_alerting():
+    funnel.clear()
+    t = [1_000.0]
+    eng = SloEngine(fast_window_s=60, slow_window_s=600, burn_alert=2.0,
+                    time_fn=lambda: t[0])
+    eng.sample()  # cumulative baseline at t=1000
+
+    # 10% upload rejection against a 1% budget -> burn 10 in both windows
+    funnel.count("uploaded", "slo_t", 100)
+    funnel.count("validated", "slo_t", 90)
+    # 5% of steps over the 1.0s threshold against the fixed 1% budget
+    for _ in range(95):
+        metrics.job_step_time.observe(0.05, test_slo="1")
+    for _ in range(5):
+        metrics.job_step_time.observe(20.0, test_slo="1")
+    t[0] += 601
+    rep = eng.evaluate()
+
+    up = rep["slos"]["upload_acceptance"]
+    for w in ("fast", "slow"):
+        assert up["windows"][w]["good"] == 90
+        assert up["windows"][w]["total"] == 100
+        assert abs(up["windows"][w]["burn_rate"] - 10.0) < 1e-6
+    assert up["alerting"] is True
+    assert up["budget_remaining"] == 0.0
+
+    step = rep["slos"]["agg_step_latency"]
+    assert step["windows"]["slow"]["good"] == 95
+    assert step["windows"]["slow"]["total"] == 100
+    assert abs(step["windows"]["slow"]["burn_rate"] - 5.0) < 1e-6
+    assert step["alerting"] is True
+    assert rep["p99_estimates"]["agg_step_latency_s"] > 1.0
+
+    # an SLI with no events in the window neither burns nor alerts
+    occ = rep["slos"]["device_occupancy"]
+    assert occ["windows"]["slow"]["ratio"] is None
+    assert occ["alerting"] is False
+    assert occ["budget_remaining"] == 1.0
+
+    assert rep["alerting"] == ["agg_step_latency", "upload_acceptance"]
+    # the gauges mirror the report
+    from janus_tpu.slo import slo_budget_remaining, slo_burn_rate
+
+    assert abs(slo_burn_rate.value(sli="upload_acceptance",
+                                   window="fast") - 10.0) < 1e-6
+    assert slo_budget_remaining.value(sli="upload_acceptance") == 0.0
+
+
+def test_slo_fast_window_recovers_before_slow_and_gates_alert():
+    """Multi-window semantics: after the error burst stops, the fast
+    window's burn falls back under the threshold while the slow window is
+    still burning — and the AND-gate stops alerting (one old spike must
+    not page)."""
+    funnel.clear()
+    t = [1_000.0]
+    eng = SloEngine(fast_window_s=60, slow_window_s=600, burn_alert=2.0,
+                    time_fn=lambda: t[0])
+    eng.sample()
+    funnel.count("uploaded", "slo_r", 100)
+    funnel.count("validated", "slo_r", 50)  # the burst
+    t[0] += 120
+    eng.sample()  # post-burst snapshot, inside the slow window
+    # a clean recent period: only good events since the burst
+    funnel.count("uploaded", "slo_r", 100)
+    funnel.count("validated", "slo_r", 100)
+    t[0] += 60  # the fast edge lands exactly on the post-burst sample
+    rep = eng.evaluate()
+    up = rep["slos"]["upload_acceptance"]
+    # fast ref = the post-burst sample -> clean; slow ref = baseline
+    assert up["windows"]["fast"]["burn_rate"] == 0.0
+    assert up["windows"]["slow"]["burn_rate"] > 2.0
+    assert up["alerting"] is False
+    assert rep["alerting"] == []
+
+
+def test_slo_custom_objective_and_debug_endpoint():
+    funnel.clear()
+    eng = SloEngine(objectives=[SloObjective(
+        "upload_acceptance", 0.5, "test objective")],
+        fast_window_s=60, slow_window_s=600)
+    set_engine(eng)
+    server = HealthServer(debug_console=True).start()
+    try:
+        funnel.count("uploaded", "slo_d", 10)
+        funnel.count("validated", "slo_d", 10)
+        r = requests.get(f"{server.address}/debug/slo", timeout=5)
+        assert r.status_code == 200
+        body = r.json()
+        assert body["windows"] == {"fast_s": 60.0, "slow_s": 600.0}
+        assert list(body["slos"]) == ["upload_acceptance"]
+        assert body["slos"]["upload_acceptance"]["objective"] == 0.5
+        assert body["alerting"] == []
+    finally:
+        server.stop()
+        set_engine(None)
+
+
+# -- flight-recorder paging ------------------------------------------------
+
+
+def test_flight_recorder_since_and_event_paging():
+    flight_recorder.clear()
+    flight_recorder.record("acquired", job_id="p1")
+    flight_recorder.record("stepped", job_id="p1")
+    flight_recorder.record("acquired", job_id="p2")
+    all_events = flight_recorder.snapshot()
+    assert [e["seq"] for e in all_events] == [1, 2, 3]
+    assert [e["job_id"]
+            for e in flight_recorder.snapshot(event="acquired")] == ["p1",
+                                                                     "p2"]
+    assert [e["seq"] for e in flight_recorder.snapshot(since=1)] == [2, 3]
+    assert flight_recorder.snapshot(since=3) == []
+    # filters compose
+    assert [e["seq"] for e in flight_recorder.snapshot(event="acquired",
+                                                       since=1)] == [3]
+
+    server = HealthServer(debug_console=True).start()
+    try:
+        r = requests.get(f"{server.address}/debug/jobs?limit=2", timeout=5)
+        page = r.json()
+        assert [e["seq"] for e in page["events"]] == [2, 3]
+        assert page["last_seq"] == 3
+        # the cursor picks up exactly where the last page ended
+        flight_recorder.record("stepped", job_id="p2")
+        r = requests.get(
+            f"{server.address}/debug/jobs?since={page['last_seq']}",
+            timeout=5)
+        page2 = r.json()
+        assert [e["seq"] for e in page2["events"]] == [4]
+        assert page2["last_seq"] == 4
+        # an empty page keeps the cursor stable
+        r = requests.get(f"{server.address}/debug/jobs?since=4&event=acquired",
+                         timeout=5)
+        assert r.json()["events"] == []
+        assert r.json()["last_seq"] == 4
+    finally:
+        server.stop()
