@@ -576,22 +576,74 @@ def probe_link_bandwidth(mb: int = 8) -> dict:
             "probe_mb": mb}
 
 
+def _reexec_on_cpu(reason: str) -> None:
+    """Replace this process with the same bench pinned to JAX_PLATFORMS=cpu.
+    jax backend selection is sticky after first use, so a fallback can't
+    just flip a flag — it must start over on a fresh interpreter."""
+    sys.stderr.write(f"bench: {reason}; re-running on CPU\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    os.execvpe(sys.executable, [sys.executable] + list(sys.argv), env)
+
+
+def _probe_devices(timeout_s: float = 90.0):
+    """jax.devices() under a watchdog: the tunneled TPU backend in this
+    deployment sometimes HANGS during init instead of raising (the socket
+    connects but the handshake never completes), which would wedge the
+    bench forever rather than fall back.  The probe runs on a daemon
+    thread; a timeout is treated exactly like an init failure.  After a
+    CPU re-exec the hung thread dies with the replaced process image."""
+    import threading
+
+    result: dict = {}
+
+    def probe():
+        try:
+            result["devices"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — report, don't swallow
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise RuntimeError(f"backend init timed out after {timeout_s:.0f}s")
+    if "error" in result:
+        raise result["error"]
+    return result["devices"]
+
+
 def _backend_platform() -> str:
     """Resolve the accelerator backend, falling back to CPU when the TPU
-    runtime can't initialize (absent chip, libtpu lock held, driver wedge).
-    jax backend selection is sticky after first use, so the fallback
-    re-execs this process pinned to JAX_PLATFORMS=cpu; the artifact then
-    records "backend": "cpu" so a score from a fallen-back run is never
-    mistaken for a device score."""
+    runtime can't initialize (absent chip, libtpu lock held, driver wedge,
+    tunnel hang); the artifact then records "backend": "cpu" so a score
+    from a fallen-back run is never mistaken for a device score."""
     try:
-        return jax.devices()[0].platform
-    except RuntimeError as e:
+        return _probe_devices()[0].platform
+    except Exception as e:
         if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
             raise  # CPU itself failed; nothing softer to fall back to
-        sys.stderr.write(
-            f"bench: backend init failed ({e}); re-running on CPU\n")
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        os.execvpe(sys.executable, [sys.executable] + list(sys.argv), env)
+        _reexec_on_cpu(f"backend init failed ({e})")
+
+
+# Backend failures that surface MID-RUN, after the startup probe passed:
+# the flaky tunnel can drop between sections, at which point the next
+# eager op raises "Unable to initialize backend 'axon': UNAVAILABLE"
+# from deep inside jax (BENCH_r05: a convert_element_type minutes in,
+# previous four rounds green).  Section-level try/excepts would record it
+# as a per-config error and exit 1; instead ANY backend-unavailable error
+# anywhere restarts the whole bench pinned to CPU.
+_BACKEND_ERR_MARKERS = ("Unable to initialize backend",
+                        "backend setup/compile error")
+
+
+def _cpu_fallback_if_backend_error(e: BaseException) -> None:
+    """Re-exec on CPU when `e` is a device-backend availability failure;
+    return (so the caller records the error) for anything else."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return
+    msg = str(e)
+    if any(marker in msg for marker in _BACKEND_ERR_MARKERS):
+        _reexec_on_cpu(f"device backend failed mid-run ({type(e).__name__})")
 
 
 def main():
@@ -605,24 +657,35 @@ def main():
         try:
             link = probe_link_bandwidth()
         except Exception as e:
+            _cpu_fallback_if_backend_error(e)
             link = {"error": f"{type(e).__name__}: {e}"}
+    if link and "up_MBps" in link:
+        # seed the streaming data plane's bandwidth estimator so the very
+        # first launches already chunk/tune for the measured weather instead
+        # of starting blind (engine/streaming.py)
+        from janus_tpu.engine import streaming as _streaming
+
+        _streaming.LINK.seed(link["up_MBps"] * 1e6, link["down_MBps"] * 1e6)
 
     if only is None or "Poplar1LeafLevel" in only:
         try:
             detail["Poplar1LeafLevel"] = bench_poplar1(smoke)
         except Exception as e:  # keep the harness unattended-safe
+            _cpu_fallback_if_backend_error(e)
             detail["Poplar1LeafLevel"] = {"error": f"{type(e).__name__}: {e}"}
 
     if only is None or "ServicePlaneHelperInit" in only:
         try:
             detail["ServicePlaneHelperInit"] = bench_service_plane(smoke)
         except Exception as e:
+            _cpu_fallback_if_backend_error(e)
             detail["ServicePlaneHelperInit"] = {"error": f"{type(e).__name__}: {e}"}
 
     if only is None or "UploadPlane" in only:
         try:
             detail["UploadPlane"] = bench_upload_plane(smoke)
         except Exception as e:
+            _cpu_fallback_if_backend_error(e)
             detail["UploadPlane"] = {"error": f"{type(e).__name__}: {e}"}
 
     for name, factory, meas, total, batch in make_configs(smoke):
@@ -669,7 +732,23 @@ def main():
             # device compute; report the better configuration
             workers = int(os.environ.get("BENCH_WORKERS", "10"))
             rps_mt, rps_mt_rounds, split_mt = 0.0, [], None
+            rps_mt_unstreamed = 0.0
             if workers > 1:
+                # Streaming A/B on the concurrent path: first with the
+                # streamed data plane OFF (synchronous host-bounce uploads,
+                # full output-share download, re-upload at aggregation —
+                # the pre-streaming plane), then ON.  Off runs first so any
+                # residual warm-up favors the baseline, not the feature.
+                inner_e = getattr(engine, "inner", engine)
+                streamed_flag = getattr(inner_e, "streaming", None)
+                if streamed_flag:
+                    try:
+                        inner_e.streaming = False
+                        rps_mt_unstreamed, _, _ = time_batches(
+                            engine, verify_key, nonces, pubs, shares, inits,
+                            batch, total, workers=workers)
+                    finally:
+                        inner_e.streaming = streamed_flag
                 fresh_split()
                 rps_mt, rps_mt_rounds, _ = time_batches(
                     engine, verify_key, nonces, pubs, shares, inits, batch,
@@ -685,6 +764,10 @@ def main():
                 "reports_per_sec": round(best, 1),
                 "serial_reports_per_sec": round(rps, 1),
                 "concurrent_reports_per_sec": round(rps_mt, 1),
+                "concurrent_reports_per_sec_unstreamed": round(
+                    rps_mt_unstreamed, 1),
+                "streaming_speedup": round(rps_mt / rps_mt_unstreamed, 3)
+                if rps_mt_unstreamed else None,
                 "rounds": rounds_best,
                 "spread_pct": round(
                     100 * (max(rounds_best) - min(rounds_best))
@@ -739,6 +822,7 @@ def main():
                         detail[name]["device_speedup_vs_native_single_core"] \
                             = round(dev / nb, 1)
         except Exception as e:  # keep the harness unattended-safe
+            _cpu_fallback_if_backend_error(e)
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
 
     star = detail.get("Prio3SumVec1000", {})
@@ -766,4 +850,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:
+        # last-ditch net for backend drops that escape the per-section
+        # handlers (e.g. inside the summary's own jax calls)
+        _cpu_fallback_if_backend_error(e)
+        raise

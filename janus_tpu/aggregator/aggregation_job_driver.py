@@ -169,9 +169,19 @@ class AggregationJobDriver:
                         reports=len(nonces)):
             prepared = engine.leader_init_batch(task.vdaf_verify_key, nonces,
                                                 pubs, shares)
+        # streaming data-plane attribution: whether this batch ran on the
+        # HBM-resident path and what the link estimate was at launch time,
+        # so a flight-recorder read of a slow job separates link weather
+        # from compute (engine/streaming.py)
+        from janus_tpu.engine import streaming as _streaming
+
+        _link = _streaming.LINK.snapshot()
         flight_recorder.record(
             "device_batch", task_id=task.task_id, job_id=job.id,
-            kind="leader_init", reports=len(nonces))
+            kind="leader_init", reports=len(nonces),
+            streamed=bool(getattr(engine, "streaming", False)),
+            link_up_bps=_link["up_bytes_per_sec"],
+            link_down_bps=_link["down_bytes_per_sec"])
 
         prepare_inits = []
         continued = []  # (ra, PreparedReport)
@@ -358,10 +368,16 @@ class AggregationJobDriver:
             tx.release_aggregation_job(lease)
 
         self.datastore.run_tx("step_agg_job_write", txn)
+        # resident_shares: lanes whose output shares stayed in HBM through
+        # init->aggregate (the writer mask-reduces them on device instead
+        # of bouncing field vectors through the host)
         flight_recorder.record(
             "stepped", task_id=task.task_id, job_id=job.id,
             kind="aggregation", step=job.step.value, state=job.state.name,
-            reports=len(writables))
+            reports=len(writables),
+            resident_shares=sum(
+                1 for w in writables
+                if getattr(w, "device_shares", None) is not None))
 
     # -- abandonment (reference :703) --------------------------------------
 

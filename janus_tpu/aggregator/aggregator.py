@@ -122,6 +122,9 @@ class TaskAggregator:
         from janus_tpu.engine.coalesce import CoalescingEngine as _CE
 
         if isinstance(engine, _BP) and engine.device_ok:
+            # adaptive defaults to the engine's streaming mode: the
+            # coalescer's max_batch/max_delay operating point follows the
+            # EWMA link estimate (engine/streaming.py)
             engine = _CE(engine)
         self.engine = engine
         self.vdaf = self.engine.vdaf

@@ -23,6 +23,7 @@ variant (core/src/vdaf.rs:24) as batched HMAC-SHA256 + AES-128-CTR kernels
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -31,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from janus_tpu import profiler
+from janus_tpu.engine import streaming
 from janus_tpu.ops import xof_batch
 from janus_tpu.ops.flp_batch import BatchFlp, field_ops
 from janus_tpu.vdaf import ping_pong
@@ -208,60 +210,128 @@ class BatchPrio3:
 
         return round_up(bucket_size(n), self._n_devices)
 
-    # Chunked double-buffering (helper path): a big batch ships as 3-4
-    # exact-bucket chunks dispatched back-to-back, so the upload of chunk
-    # k+1 overlaps the kernel of chunk k on the device queue — transfers
-    # DO overlap compute on this runtime (measured: 8MB H2D + 286ms kernel
-    # = 1046ms combined vs 1418ms serial).  Chunks are contiguous and only
-    # the last is padded, so report i stays at concat lane i.
+    # Chunked double-buffering: a big batch ships as 2-4 exact-bucket
+    # chunks, each explicitly staged with an async jax.device_put, so the
+    # upload of chunk k+1 overlaps the kernel of chunk k on the device
+    # queue — transfers DO overlap compute on this runtime (measured: 8MB
+    # H2D + 286ms kernel = 1046ms combined vs 1418ms serial).  Chunks are
+    # contiguous and only the last is padded, so report i stays at concat
+    # lane i.
     #
-    # OFF BY DEFAULT, by measurement: at 24576 SumVec-1000 lanes the
-    # 3-chunk pipeline ran ~40% SLOWER than one launch on the tunneled
-    # chip — each chunk kernel pays the full per-launch fixed cost
-    # (~60-100ms of scan dispatch overhead), which outweighs the overlap
-    # it buys, and concurrent jobs (the service's normal shape) already
-    # overlap their transfers with each other's kernels for free.  The
-    # mechanism stays for giant single jobs and PCIe-attached chips where
-    # per-launch overhead is microseconds: set JANUS_TPU_CHUNKED_DISPATCH=1
-    # (or flip the instance attribute) to enable.
+    # WHEN to chunk is link weather, not a constant: at 24576 SumVec-1000
+    # lanes a fixed 3-chunk pipeline ran ~40% SLOWER than one launch on a
+    # fast day (each chunk kernel pays ~60-100ms of scan dispatch
+    # overhead), while on a 5 MB/s tunnel day the single upload alone
+    # takes seconds the chip spends idle.  So the default policy is
+    # ADAPTIVE (engine/streaming.py): chunk only when the EWMA link
+    # estimate says the upload is long enough to hide behind chunked
+    # compute.  JANUS_TPU_CHUNKED_DISPATCH=1 forces the fixed 3-way plan;
+    # JANUS_PREPARE_CHUNK=<lanes> pins an explicit chunk size;
+    # JANUS_PREPARE_STREAMING=0 disables staging, adaptive chunking and
+    # HBM residency entirely (outputs bounce through the host, the
+    # pre-streaming data plane).
     _CHUNK_MIN = 8192
     chunked_dispatch = bool(int(
-        __import__("os").environ.get("JANUS_TPU_CHUNKED_DISPATCH", "0")))
+        os.environ.get("JANUS_TPU_CHUNKED_DISPATCH", "0")))
+    streaming = bool(int(os.environ.get("JANUS_PREPARE_STREAMING", "1")))
+    _chunk_override = int(os.environ.get("JANUS_PREPARE_CHUNK", "0") or 0)
 
-    def _chunk_plan(self, n: int) -> list[int] | None:
-        if (not self.chunked_dispatch or self.mesh is not None
-                or n < 2 * self._CHUNK_MIN):
+    def lane_upload_bytes(self, kind: str = "helper") -> int:
+        """Host->device bytes per lane for one init launch — the adaptive
+        chunk/coalesce sizing input (engine/streaming.py)."""
+        ss = self.vdaf.SEED_SIZE
+        ks = self.vdaf.VERIFY_KEY_SIZE
+        if kind == "helper":
+            # packed row + leader verifier limbs (_pack_helper_inputs)
+            return (ks + 4 * ss + 16
+                    + self.P * self.flp.VERIFIER_LEN * self.L * 4)
+        # leader: packed row + measurement + proof limb tensors
+        return (ks + 2 * ss + 16 + self.flp.MEAS_LEN * self.L * 4
+                + self.P * self.flp.PROOF_LEN * self.L * 4)
+
+    def _chunk_plan(self, n: int, kind: str = "helper") -> list[int] | None:
+        if self.mesh is not None:
             return None
-        target = -(-n // 3)
-        c = 8
-        while True:  # engine-grid floor: largest bucket <= target
-            # grid walk: power of two -> *3/2 midpoint -> next power of two
-            nxt = c * 3 // 2 if (c & (c - 1)) == 0 else c * 4 // 3
-            if nxt > target:
-                break
-            c = nxt
-        full, rem = divmod(n, c)
-        sizes = [c] * full
-        if rem:
-            sizes.append(bucket_size(rem))
-        return sizes if len(sizes) > 1 else None
+        if self._chunk_override:
+            c = bucket_size(self._chunk_override)
+            if n < 2 * c:
+                return None
+            full, rem = divmod(n, c)
+            sizes = [c] * full
+            if rem:
+                sizes.append(bucket_size(rem))
+            return sizes if len(sizes) > 1 else None
+        if self.chunked_dispatch and n >= 2 * self._CHUNK_MIN:
+            target = -(-n // 3)
+            c = 8
+            while True:  # engine-grid floor: largest bucket <= target
+                # grid walk: power of two -> *3/2 midpoint -> next power of two
+                nxt = c * 3 // 2 if (c & (c - 1)) == 0 else c * 4 // 3
+                if nxt > target:
+                    break
+                c = nxt
+            full, rem = divmod(n, c)
+            sizes = [c] * full
+            if rem:
+                sizes.append(bucket_size(rem))
+            return sizes if len(sizes) > 1 else None
+        if self.streaming:
+            return streaming.adaptive_chunk_plan(
+                n, self.lane_upload_bytes(kind), min_chunk=self._CHUNK_MIN)
+        return None
 
-    def _concat_fn(self, sizes: tuple[int, ...]):
+    def _concat_fn(self, sizes: tuple[int, ...],
+                   axes: tuple[int, ...] = (0, -1)):
         """Jitted on-device concat of per-chunk outputs: the host then
         pays ONE result fetch instead of one per chunk (each fetch costs
-        a full link round trip)."""
-        key = ("concat",) + sizes
+        a full link round trip).  `axes` gives each output's batch axis —
+        host-bound rows are batch-leading (0), resident field tensors
+        batch-minor (-1)."""
+        key = ("concat", axes) + sizes
         fn = self._helper_fns.get(key)
         if fn is None:
             k = len(sizes)
 
             def concat(*arrs):
-                return (jnp.concatenate(arrs[:k], axis=0),
-                        jnp.concatenate(arrs[k:], axis=-1))
+                return tuple(
+                    jnp.concatenate(arrs[j * k:(j + 1) * k], axis=ax)
+                    for j, ax in enumerate(axes))
 
             fn = jax.jit(concat)
             self._helper_fns[key] = fn
         return fn
+
+    def _stage(self, arrays: tuple, timed: bool) -> tuple:
+        """Async-stage host arrays into HBM with explicit jax.device_put.
+
+        `timed` blocks on completion and feeds the link estimator — used
+        for the first chunk of a launch (nothing to overlap with yet) and
+        for single launches; later chunks stage un-timed so their
+        transfers overlap the previous chunk's kernel.  Returns
+        (device_arrays, upload_seconds)."""
+        t0 = time.monotonic()
+        staged = tuple(jax.device_put(a) for a in arrays)
+        if not timed:
+            return staged, 0.0
+        for d in staged:
+            d.block_until_ready()
+        dt = time.monotonic() - t0
+        streaming.LINK.record_up(sum(a.nbytes for a in arrays), dt)
+        return staged, dt
+
+    def _fetch(self, device_arrays: tuple) -> tuple:
+        """Materialize host-bound outputs with the compute wait split from
+        the transfer: block first (kernel time attributes to the device
+        phase), then time the pure fetch and feed the link estimator.
+        Returns (host_arrays, compute_wait_s, fetch_s)."""
+        t0 = time.monotonic()
+        for d in device_arrays:
+            d.block_until_ready()
+        t1 = time.monotonic()
+        out = tuple(np.asarray(d) for d in device_arrays)
+        t2 = time.monotonic()
+        streaming.LINK.record_down(sum(a.nbytes for a in out), t2 - t1)
+        return out, t1 - t0, t2 - t1
 
     def _jit(self, kernel, n_sharded_args: int, out_specs):
         """jit, sharding batch arguments/outputs over the report mesh when
@@ -663,6 +733,7 @@ class BatchPrio3:
             inbound_messages)
 
         t0 = time.monotonic()
+        transfer_s = 0.0
         # Only the small per-lane outputs come back to the host; the output
         # shares ([L, OUTPUT_LEN, M] — by far the largest tensor) and the
         # helper verifier stay on device.  Downstream aggregation reduces
@@ -670,20 +741,48 @@ class BatchPrio3:
         # per batch (HBM-bandwidth discipline; the 1-round helper never
         # sends its verifier on the wire, only the finish seed).
         if chunk_sizes:
-            # back-to-back chunk dispatch: chunk k+1's upload overlaps
-            # chunk k's kernel; a device-side concat keeps the host at ONE
-            # result fetch (each fetch costs a full link round trip)
-            parts, off = [], 0
-            for c in chunk_sizes:
-                cfn = self._helper_fn(c)
-                parts.append(cfn(packed[off:off + c], lverif[off:off + c]))
-                off += c
+            # double-buffered chunk dispatch: chunk 0's upload is timed
+            # (there is nothing for it to overlap with), then each kernel
+            # dispatch is chased by the async staging of the NEXT chunk so
+            # its transfer overlaps this chunk's kernel; a device-side
+            # concat keeps the host at ONE result fetch (each fetch costs
+            # a full link round trip)
+            offs = [0]
+            for c in chunk_sizes[:-1]:
+                offs.append(offs[-1] + c)
+
+            def slices(k: int) -> tuple:
+                o, c = offs[k], chunk_sizes[k]
+                return (packed[o:o + c], lverif[o:o + c])
+
+            staged, t_up = self._stage(slices(0), timed=self.streaming)
+            transfer_s += t_up
+            parts = []
+            for k, c in enumerate(chunk_sizes):
+                parts.append(self._helper_fn(c)(*staged))
+                if k + 1 < len(chunk_sizes):
+                    staged, _ = self._stage(slices(k + 1), timed=False)
             packed_out_d, out_share_d = self._concat_fn(tuple(chunk_sizes))(
                 *[p[0] for p in parts], *[p[1] for p in parts])
+        elif self.streaming:
+            # explicit timed staging: the upload observation feeds the
+            # link estimator that sizes future chunk plans
+            (packed_d, lverif_d), t_up = self._stage((packed, lverif),
+                                                     timed=True)
+            transfer_s += t_up
+            packed_out_d, out_share_d = self._helper_fn(M)(packed_d,
+                                                           lverif_d)
         else:
-            fn = self._helper_fn(M)
-            packed_out_d, out_share_d = fn(packed, lverif)
-        packed_out = np.asarray(packed_out_d)
+            packed_out_d, out_share_d = self._helper_fn(M)(packed, lverif)
+        if self.streaming:
+            (packed_out,), _wait, t_down = self._fetch((packed_out_d,))
+            transfer_s += t_down
+        else:
+            packed_out = np.asarray(packed_out_d)
+            # non-streamed mode (JANUS_PREPARE_STREAMING=0): the
+            # pre-streaming data plane — output shares bounce through the
+            # host and aggregation re-uploads them
+            out_share_d = np.asarray(out_share_d)
         msg_seed = packed_out[:, :ss]
         proof_ok = packed_out[:, ss].astype(bool)
         jr_ok = packed_out[:, ss + 1].astype(bool)
@@ -719,7 +818,8 @@ class BatchPrio3:
             out.append(PreparedReport(
                 "finished", outbound=mk_msg(FINISH, prep_msg=prep_msg),
                 out_share_raw=LaneRef(out_share_d, i),
-                device_shares=out_share_d, lane=i,
+                device_shares=out_share_d if self.streaming else None,
+                lane=i if self.streaming else None,
             ))
         t_end = time.monotonic()
         with self._timings_lock:
@@ -730,8 +830,9 @@ class BatchPrio3:
             tm["batches"] += 1
         profiler.record_batch(
             "helper_init", type(self.vdaf).__name__, bucket=M, reports=N,
-            decode_s=t0 - t_begin, device_s=t_dev - t0,
-            encode_s=t_end - t_dev,
+            decode_s=t0 - t_begin,
+            device_s=max(t_dev - t0 - transfer_s, 0.0),
+            encode_s=t_end - t_dev, transfer_s=transfer_s,
             compile_state="cold" if cold else "warm")
         return out
 
@@ -769,8 +870,10 @@ class BatchPrio3:
                 encode_s=0.0, device=False)
             return out
         t_begin = time.monotonic()
-        M = self._bucket(N)
-        cold = M not in self._leader_fns
+        chunk_sizes = self._chunk_plan(N, kind="leader")
+        M = sum(chunk_sizes) if chunk_sizes else self._bucket(N)
+        cold = (any(c not in self._leader_fns for c in chunk_sizes)
+                if chunk_sizes else M not in self._leader_fns)
         ss = self.vdaf.SEED_SIZE
         ks = self.vdaf.VERIFY_KEY_SIZE
         meas_raw = np.zeros((M, self.flp.MEAS_LEN, self.L), dtype=np.uint32)
@@ -822,15 +925,52 @@ class BatchPrio3:
             vk[:N] = _bytes_rows(list(verify_key), ks)
         else:
             vk[:N] = np.frombuffer(verify_key, dtype=np.uint8)
-        fn = self._leader_fn(M)
         nonce_rows[:N] = nonces_arr(nonces)
         t0 = time.monotonic()
+        transfer_s = 0.0
         # The leader's verifier IS wire payload (PrepareInit prep share), so
         # it must come to the host; output shares stay on device.
-        verif_raw_d, packed_out_d, out_share_d = fn(
-            packed, meas_raw, proofs_raw)
-        verif_raw = np.asarray(verif_raw_d)
-        packed_out = np.asarray(packed_out_d)
+        if chunk_sizes:
+            # double-buffered chunk dispatch, mirroring the helper path:
+            # chunk k+1's staging overlaps chunk k's kernel
+            offs = [0]
+            for c in chunk_sizes[:-1]:
+                offs.append(offs[-1] + c)
+
+            def slices(k: int) -> tuple:
+                o, c = offs[k], chunk_sizes[k]
+                return (packed[o:o + c], meas_raw[o:o + c],
+                        proofs_raw[o:o + c])
+
+            staged, t_up = self._stage(slices(0), timed=self.streaming)
+            transfer_s += t_up
+            parts = []
+            for k, c in enumerate(chunk_sizes):
+                parts.append(self._leader_fn(c)(*staged))
+                if k + 1 < len(chunk_sizes):
+                    staged, _ = self._stage(slices(k + 1), timed=False)
+            verif_raw_d, packed_out_d, out_share_d = self._concat_fn(
+                tuple(chunk_sizes), axes=(0, 0, -1))(
+                *[p[0] for p in parts], *[p[1] for p in parts],
+                *[p[2] for p in parts])
+        elif self.streaming:
+            (packed_d, meas_d, proofs_d), t_up = self._stage(
+                (packed, meas_raw, proofs_raw), timed=True)
+            transfer_s += t_up
+            verif_raw_d, packed_out_d, out_share_d = self._leader_fn(M)(
+                packed_d, meas_d, proofs_d)
+        else:
+            verif_raw_d, packed_out_d, out_share_d = self._leader_fn(M)(
+                packed, meas_raw, proofs_raw)
+        if self.streaming:
+            (verif_raw, packed_out), _wait, t_down = self._fetch(
+                (verif_raw_d, packed_out_d))
+            transfer_s += t_down
+        else:
+            verif_raw = np.asarray(verif_raw_d)
+            packed_out = np.asarray(packed_out_d)
+            # non-streamed mode: output shares bounce through the host
+            out_share_d = np.asarray(out_share_d)
         t_dev = time.monotonic()
         own_part = packed_out[:, :ss]
         state_seed = packed_out[:, ss:2 * ss]
@@ -861,7 +1001,8 @@ class BatchPrio3:
                 "continued", outbound=outbound,
                 out_share_raw=LaneRef(out_share_d, i),
                 prep_share=prep_share, state=state,
-                device_shares=out_share_d, lane=i,
+                device_shares=out_share_d if self.streaming else None,
+                lane=i if self.streaming else None,
             ))
         t_end = time.monotonic()
         with self._timings_lock:
@@ -872,8 +1013,9 @@ class BatchPrio3:
             tm["batches"] += 1
         profiler.record_batch(
             "leader_init", type(self.vdaf).__name__, bucket=M, reports=N,
-            decode_s=t0 - t_begin, device_s=t_dev - t0,
-            encode_s=t_end - t_dev,
+            decode_s=t0 - t_begin,
+            device_s=max(t_dev - t0 - transfer_s, 0.0),
+            encode_s=t_end - t_dev, transfer_s=transfer_s,
             compile_state="cold" if cold else "warm")
         return out
 
@@ -948,9 +1090,52 @@ class BatchPrio3:
         return self.aggregate_raw_rows(rows)
 
     def aggregate_raw_rows(self, rows: list) -> list[int]:
-        """Device tree-sum of raw output-share rows -> aggregate share ints."""
+        """Device tree-sum of raw output-share rows -> aggregate share ints.
+
+        Rows may be host arrays OR LaneRef handles into HBM-resident init
+        batches.  Handles are grouped by the batch they reference and each
+        group reduces ON DEVICE with a lane mask — init -> aggregate never
+        bounces field vectors through the host (only one [OUTPUT_LEN, L]
+        partial sum per referenced batch comes back).  Host rows take the
+        upload-and-reduce path; partials combine with exact modular
+        addition, so the result is bit-identical to folding every row
+        sequentially regardless of how the rows were partitioned."""
         if not rows:
             return self.vdaf.aggregate_init()
+        jax_array = getattr(jax, "Array", ())
+        groups: dict[int, tuple] = {}
+        host_rows: list = []
+        for r in rows:
+            arr = getattr(r, "array", None)
+            lane = getattr(r, "lane", None)
+            if (arr is not None and lane is not None
+                    and isinstance(arr, jax_array)):
+                groups.setdefault(id(arr), (arr, []))[1].append(lane)
+            else:
+                host_rows.append(r)
+        handles = []
+        for arr, lanes in groups.values():
+            if len(set(lanes)) != len(lanes):
+                # a repeated lane can't be expressed as a 0/1 mask;
+                # materialize that group on the host instead
+                host_rows.extend(LaneRef(arr, i) for i in lanes)
+                continue
+            mask = np.zeros(arr.shape[-1], dtype=bool)
+            mask[np.asarray(lanes)] = True
+            # async dispatch: all group reduces are in flight before the
+            # first result materializes
+            handles.append(self.aggregate_masked_launch(arr, mask))
+        parts = [self.aggregate_resolve(h) for h in handles]
+        if host_rows:
+            parts.append(self._aggregate_host_rows(host_rows))
+        if len(parts) == 1:
+            return parts[0]
+        mod = self.field.MODULUS
+        return [sum(vals) % mod for vals in zip(*parts)]
+
+    def _aggregate_host_rows(self, rows: list) -> list[int]:
+        """Upload-and-reduce for host-resident rows (the pre-streaming
+        path, still used for host-oracle fallback lanes)."""
         rows = [np.asarray(r) for r in rows]  # each [OUTPUT_LEN, L]
         K = len(rows)
         M = self._bucket(K)

@@ -23,7 +23,9 @@ CPU threads — here overlapping jobs become literally one kernel launch.
 from __future__ import annotations
 
 import threading
+import time
 
+from janus_tpu.engine import streaming
 from janus_tpu.engine.batch import BatchPrio3, PreparedReport
 
 
@@ -48,10 +50,21 @@ class CoalescingEngine:
     """
 
     def __init__(self, inner: BatchPrio3, max_batch: int = 16384,
-                 max_delay_ms: float = 4.0, launch_depth: int = 4):
+                 max_delay_ms: float = 4.0, launch_depth: int = 4,
+                 adaptive: bool | None = None):
         self.inner = inner
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1000.0
+        # Link-adaptive operating point (engine/streaming.py): retune
+        # max_batch/max_delay from the EWMA link estimate — a 5 MB/s
+        # tunnel favors small launches the chunker can overlap, a 1 GB/s
+        # link favors big dispatch-amortizing buckets.  Defaults to the
+        # inner engine's streaming mode; the constructor values act as the
+        # no-estimate fallback.
+        self.adaptive = (getattr(inner, "streaming", False)
+                         if adaptive is None else adaptive)
+        self._tune_defaults = (max_batch, max_delay_ms)
+        self._last_retune = 0.0
         self._lock = threading.Lock()
         self._queue: list[_Pending] = []
         self._dispatcher: threading.Thread | None = None
@@ -120,6 +133,28 @@ class CoalescingEngine:
 
     # -- machinery ---------------------------------------------------------
 
+    def _retune(self) -> None:
+        """Refresh max_batch/max_delay from the link estimate (at most
+        once a second — the EWMA moves slowly and the dispatch loop is
+        hot).  Runs on the dispatcher thread; max_batch/max_delay are
+        plain attribute writes racing only with reads, which is benign —
+        every interleaving is a valid operating point."""
+        if not self.adaptive:
+            return
+        now = time.monotonic()
+        if now - self._last_retune < 1.0:
+            return
+        self._last_retune = now
+        lane_bytes = getattr(self.inner, "lane_upload_bytes", None)
+        if lane_bytes is None:
+            return
+        mb, delay_ms = streaming.recommend_coalesce_params(
+            streaming.LINK, lane_bytes("helper"),
+            default_max_batch=self._tune_defaults[0],
+            default_delay_ms=self._tune_defaults[1])
+        self.max_batch = mb
+        self.max_delay = delay_ms / 1000.0
+
     def _submit(self, kind: str, verify_key, args) -> list[PreparedReport]:
         n = len(args[0])
         if n == 0:
@@ -142,11 +177,10 @@ class CoalescingEngine:
         return p.result
 
     def _dispatch_loop(self) -> None:
-        import time
-
         batch: list[_Pending] = []
         try:
             while True:
+                self._retune()
                 time.sleep(self.max_delay)  # collection window
                 with self._lock:
                     if not self._queue:

@@ -59,17 +59,30 @@ class FusedLaunch:
     def fetch(self) -> dict:
         """Block on the single device->host transfer; split the columns.
 
+        The kernel wait is split from the fetch so the profiler attributes
+        transfer and compute separately: block first (compute), then time
+        the materialization alone (downlink) and feed the link estimator.
+
         Returns msg_seeds [N, ss] u8 plus per-lane bool arrays: ok_hpke,
         pt_ok, msg_ok, range_ok, proof_ok, jr_ok, fallback."""
         if self._res is None:
-            out = np.asarray(self._out_d)[: self.n]
+            from janus_tpu.engine import streaming
+
+            self._out_d.block_until_ready()
+            t_fetch = time.perf_counter()
+            full = np.asarray(self._out_d)
+            t_done = time.perf_counter()
+            streaming.LINK.record_down(full.nbytes, t_done - t_fetch)
+            out = full[: self.n]
             if self._profile is not None:
                 p = self._profile
+                transfer = p.get("transfer_s", 0.0) + (t_done - t_fetch)
                 profiler.record_batch(
                     "fused_helper_init", p["vdaf"], bucket=p["bucket"],
                     reports=self.n, decode_s=p["decode_s"],
-                    device_s=time.perf_counter() - p["t_dispatch"],
-                    encode_s=0.0, compile_state=p["compile_state"])
+                    device_s=max(t_fetch - p["t_dispatch"], 0.0),
+                    encode_s=0.0, transfer_s=transfer,
+                    compile_state=p["compile_state"])
             ss = self._ss
             flags = out[:, ss:].astype(bool)
             self._res = {
@@ -301,10 +314,28 @@ class FusedHelperInit:
             cold = (M, cl, pl, ml) not in self._fns
         fn = self._fn(M, cl, pl, ml)
         t_pack = time.perf_counter()
-        out_d, share_d = fn(const_row, lanes)
+        t_up = 0.0
+        if getattr(e, "streaming", False):
+            # explicit timed staging (streaming data plane): the upload
+            # observation feeds the link estimator, and t_dispatch then
+            # cleanly brackets kernel time for the profiler split
+            from janus_tpu.engine import streaming
+
+            const_d = jax.device_put(const_row)
+            lanes_d = jax.device_put(lanes)
+            const_d.block_until_ready()
+            lanes_d.block_until_ready()
+            t_up = time.perf_counter() - t_pack
+            streaming.LINK.record_up(const_row.nbytes + lanes.nbytes, t_up)
+            t_dispatch = time.perf_counter()
+            out_d, share_d = fn(const_d, lanes_d)
+        else:
+            t_dispatch = t_pack
+            out_d, share_d = fn(const_row, lanes)
         return FusedLaunch(out_d, share_d, n, ss, e.has_jr, profile={
             "vdaf": type(e.vdaf).__name__, "bucket": M,
-            "decode_s": t_pack - t_begin, "t_dispatch": t_pack,
+            "decode_s": t_pack - t_begin, "t_dispatch": t_dispatch,
+            "transfer_s": t_up,
             "compile_state": "cold" if cold else "warm"})
 
 

@@ -1,0 +1,201 @@
+"""Link-adaptive policy for the streaming prepare data plane.
+
+The north-star workload is LINK-bound, not compute-bound: SumVec-1000
+carries ~1.15 KB of wire data per report while the kernel sustains ~70k
+reports/s with device-resident inputs, and the measured host<->device link
+swings 5 MB/s-1 GB/s run to run (bench.py:probe_link_bandwidth).  A fixed
+chunking/coalescing operating point is therefore wrong most of the time:
+on a 5 MB/s tunnel the upload of a 24576-lane batch takes seconds and
+should be split into overlapped chunks; at 1 GB/s the same split only
+multiplies per-launch dispatch overhead.
+
+This module holds the shared state that lets the engine pick per-launch:
+
+- `LinkBandwidthEstimator` — EWMA over transfer observations the engine
+  makes anyway (the timed device_put of a launch's inputs, the timed fetch
+  of its outputs), seeded by the bench's synthetic probe.  Exported as the
+  `janus_link_{up,down}_bytes_per_sec` gauges.
+- `adaptive_chunk_plan` — given a batch size and its per-lane upload
+  bytes, decide whether double-buffered chunking beats one launch and
+  size the chunks on the engine's bucket grid (engine/batch.py).
+- `recommend_coalesce_params` — the CoalescingEngine operating point
+  (`max_batch`, `max_delay_ms`) for the current link estimate.
+
+Reference analog: the job-driver concurrency coalescing of SURVEY
+§2.7/§5, applied one level down to the DMA link instead of the CPU pool.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from janus_tpu import metrics
+
+# Upload time below which chunking cannot pay for its extra launches: the
+# per-launch fixed cost on the tunneled chip is ~60-100ms of dispatch, so
+# a transfer that hides entirely behind one kernel stays a single launch.
+MIN_OVERLAP_S = 0.25
+# Per-chunk transfer budget when chunking IS worth it: small enough that
+# the first kernel starts quickly, big enough that per-launch overhead
+# stays amortized.
+TARGET_CHUNK_S = 0.4
+MAX_CHUNKS = 4
+
+
+class LinkBandwidthEstimator:
+    """EWMA bytes/sec estimate of the host->device (up) and device->host
+    (down) link, fed by observations the data plane makes anyway.
+
+    Thread-safe; tiny transfers (under `min_bytes`) are ignored — they
+    measure per-transfer latency, not bandwidth, and one 4 KB flag row
+    timed at 100ms RTT would crater the estimate an order of magnitude
+    below what bulk transfers actually sustain.
+    """
+
+    def __init__(self, alpha: float = 0.3, min_bytes: int = 262144):
+        self._alpha = alpha
+        self._min_bytes = min_bytes
+        self._lock = threading.Lock()
+        self._up: float | None = None
+        self._down: float | None = None
+        self._observations = 0
+
+    def _fold(self, cur: float | None, bps: float) -> float:
+        return bps if cur is None else self._alpha * bps + (1 - self._alpha) * cur
+
+    def record_up(self, nbytes: int, seconds: float) -> None:
+        if seconds <= 0 or nbytes < self._min_bytes:
+            return
+        with self._lock:
+            self._up = self._fold(self._up, nbytes / seconds)
+            self._observations += 1
+            up = self._up
+        metrics.link_up_bytes_per_sec.set(up)
+
+    def record_down(self, nbytes: int, seconds: float) -> None:
+        if seconds <= 0 or nbytes < self._min_bytes:
+            return
+        with self._lock:
+            self._down = self._fold(self._down, nbytes / seconds)
+            self._observations += 1
+            down = self._down
+        metrics.link_down_bytes_per_sec.set(down)
+
+    def seed(self, up_bps: float | None = None,
+             down_bps: float | None = None) -> None:
+        """Install probe results (bench.py:probe_link_bandwidth) as the
+        starting estimate; real observations take over from there."""
+        with self._lock:
+            if up_bps and up_bps > 0:
+                self._up = self._fold(self._up, float(up_bps))
+            if down_bps and down_bps > 0:
+                self._down = self._fold(self._down, float(down_bps))
+        if up_bps and up_bps > 0:
+            metrics.link_up_bytes_per_sec.set(float(up_bps))
+        if down_bps and down_bps > 0:
+            metrics.link_down_bytes_per_sec.set(float(down_bps))
+
+    def up_bps(self) -> float | None:
+        with self._lock:
+            return self._up
+
+    def down_bps(self) -> float | None:
+        with self._lock:
+            return self._down
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "up_bytes_per_sec": round(self._up, 1) if self._up else None,
+                "down_bytes_per_sec": (round(self._down, 1)
+                                       if self._down else None),
+                "observations": self._observations,
+            }
+
+    def reset(self) -> None:
+        """Forget all observations (tests)."""
+        with self._lock:
+            self._up = self._down = None
+            self._observations = 0
+
+
+# Process-wide estimator: every engine instance shares one link.
+LINK = LinkBandwidthEstimator()
+
+
+def _grid_floor(target: int, minimum: int = 8) -> int:
+    """Largest engine bucket (power of two or 1.5x midpoint) <= target."""
+    if target <= minimum:
+        return minimum
+    c = minimum
+    while True:
+        # grid walk: power of two -> *3/2 midpoint -> next power of two
+        nxt = c * 3 // 2 if (c & (c - 1)) == 0 else c * 4 // 3
+        if nxt > target:
+            return c
+        c = nxt
+
+
+def adaptive_chunk_plan(n: int, bytes_per_lane: int,
+                        estimator: LinkBandwidthEstimator | None = None,
+                        min_chunk: int = 8192) -> list[int] | None:
+    """Chunk sizes for a double-buffered upload, or None for one launch.
+
+    Chunks only when the estimated upload time is long enough that hiding
+    it behind chunked compute beats the extra per-launch dispatch cost.
+    Chunks are contiguous, sit on the engine bucket grid (only the last is
+    padded, by the caller's bucket_size), and there are at most MAX_CHUNKS
+    — beyond ~4 the marginal overlap is nil but the dispatch cost is not.
+    With no bandwidth estimate yet there is no basis to chunk: returns
+    None and lets the launch itself produce the first observation.
+    """
+    from janus_tpu.engine.batch import bucket_size
+
+    if estimator is None:
+        estimator = LINK
+    if n < 2 * min_chunk or bytes_per_lane <= 0:
+        return None
+    up = estimator.up_bps()
+    if not up:
+        return None
+    upload_s = n * bytes_per_lane / up
+    if upload_s < MIN_OVERLAP_S:
+        return None
+    k = max(2, min(MAX_CHUNKS, round(upload_s / TARGET_CHUNK_S)))
+    c = _grid_floor(-(-n // k))
+    if c < min_chunk // 2 or c >= n:
+        return None
+    full, rem = divmod(n, c)
+    sizes = [c] * full
+    if rem:
+        sizes.append(bucket_size(rem))
+    return sizes if len(sizes) > 1 else None
+
+
+def recommend_coalesce_params(
+        estimator: LinkBandwidthEstimator | None,
+        bytes_per_lane: int,
+        default_max_batch: int = 16384,
+        default_delay_ms: float = 4.0) -> tuple[int, float]:
+    """CoalescingEngine operating point for the current link estimate.
+
+    `max_batch` targets one launch-upload-budget worth of lanes: a fast
+    link favors big buckets (dispatch amortization), a slow link favors
+    launches small enough that the streaming chunker and concurrent jobs
+    can overlap transfers with compute.  `max_delay_ms` scales with how
+    expensive a launch is on this link: when each launch costs hundreds of
+    milliseconds of transfer, waiting longer to fill it is nearly free;
+    when launches are cheap, a long window only adds latency.
+    """
+    if estimator is None:
+        estimator = LINK
+    up = estimator.up_bps()
+    if not up or bytes_per_lane <= 0:
+        return default_max_batch, default_delay_ms
+    # lanes whose upload fits the per-chunk budget, snapped to the grid
+    lanes = int(up * TARGET_CHUNK_S / bytes_per_lane)
+    max_batch = max(1024, min(65536, _grid_floor(max(lanes, 8))))
+    # one collection window ~= 1% of the launch upload time, clamped
+    upload_ms = 1000.0 * max_batch * bytes_per_lane / up
+    delay_ms = min(16.0, max(1.0, upload_ms / 100.0))
+    return max_batch, delay_ms

@@ -151,10 +151,19 @@ def _debug_profile(query: dict) -> dict:
             })
         except Exception:
             continue
+    # link weather beside the per-launch transfer/compute split: the
+    # streaming data plane's EWMA bandwidth estimate (engine/streaming.py)
+    try:
+        from janus_tpu.engine import streaming
+
+        link = streaming.LINK.snapshot()
+    except Exception:
+        link = None
     return {
         "batches": profiler.snapshot(limit=limit),
         "summary": profiler.summary(),
         "engines": engines,
+        "link": link,
     }
 
 

@@ -314,6 +314,19 @@ upload_open_stragglers = REGISTRY.counter(
 helper_rtt_seconds = REGISTRY.histogram(
     "janus_helper_rtt_seconds",
     "leader->helper request round-trip latency (incl. retries) by method")
+# streaming prepare data plane (engine/streaming.py, engine/batch.py):
+# the EWMA link estimate driving adaptive chunk/coalesce sizing, and the
+# host<->device transfer share of each prepare launch
+link_up_bytes_per_sec = REGISTRY.gauge(
+    "janus_link_up_bytes_per_sec",
+    "EWMA host->device link bandwidth observed by the prepare data plane")
+link_down_bytes_per_sec = REGISTRY.gauge(
+    "janus_link_down_bytes_per_sec",
+    "EWMA device->host link bandwidth observed by the prepare data plane")
+prepare_transfer_seconds = REGISTRY.histogram(
+    "janus_prepare_transfer_seconds",
+    "host<->device transfer time per prepare launch (upload of inputs + "
+    "fetch of host-bound outputs), by engine kind")
 
 
 def all_instruments() -> list:

@@ -36,11 +36,15 @@ _lock = threading.Lock()
 _records: deque = deque(maxlen=_capacity())
 # kind -> [padded_lanes_total, lanes_total] for the cumulative waste gauge
 _padding: dict[str, list] = {}
+# kind -> [transfer_s_total, device_s_total] so /debug/profile can show the
+# transfer-vs-compute split of the streaming data plane per engine kind
+_phase_totals: dict[str, list] = {}
 
 
 def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
                  decode_s: float, device_s: float, encode_s: float,
-                 compile_state: str = "warm", device: bool = True) -> None:
+                 compile_state: str = "warm", device: bool = True,
+                 transfer_s: float = 0.0) -> None:
     """Record one engine batch.
 
     kind: engine entry point ("helper_init", "leader_init",
@@ -48,6 +52,9 @@ def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
     bucket: padded batch size actually launched; reports: real reports.
     compile_state: "cold" when this launch paid the kernel compile.
     device: False for a host-fallback batch.
+    transfer_s: host<->device transfer time measured separately from
+        device_s (streaming data plane); 0.0 when the engine launched
+        without explicit staging and the transfer hides inside device_s.
     """
     bucket = max(int(bucket), 1)
     reports = int(reports)
@@ -65,10 +72,11 @@ def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
         "device": bool(device),
         "phases": {
             "decode_s": round(decode_s, 6),
+            "transfer_s": round(transfer_s, 6),
             "device_s": round(device_s, 6),
             "encode_s": round(encode_s, 6),
         },
-        "total_s": round(decode_s + device_s + encode_s, 6),
+        "total_s": round(decode_s + transfer_s + device_s + encode_s, 6),
     }
     with _lock:
         _records.append(rec)
@@ -76,6 +84,9 @@ def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
         pad[0] += padded
         pad[1] += bucket
         waste = pad[0] / pad[1] if pad[1] else 0.0
+        ph = _phase_totals.setdefault(kind, [0.0, 0.0])
+        ph[0] += transfer_s
+        ph[1] += device_s
     metrics.device_batch_seconds.observe(device_s, kind=kind,
                                          bucket=str(bucket))
     metrics.device_batch_reports.add(reports, kind=kind)
@@ -85,6 +96,10 @@ def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
                                                phase="device")
     metrics.device_batch_phase_seconds.observe(encode_s, kind=kind,
                                                phase="encode")
+    if transfer_s > 0.0:
+        metrics.device_batch_phase_seconds.observe(transfer_s, kind=kind,
+                                                   phase="transfer")
+        metrics.prepare_transfer_seconds.observe(transfer_s, kind=kind)
     metrics.device_batch_occupancy.observe(occupancy, kind=kind)
     if padded:
         metrics.device_batch_padded_lanes.add(padded, kind=kind)
@@ -103,12 +118,23 @@ def snapshot(limit: int | None = None) -> list[dict]:
 
 
 def summary() -> dict:
-    """Cumulative per-kind padding waste for /debug/profile."""
+    """Cumulative per-kind padding waste and transfer/compute split for
+    /debug/profile."""
     with _lock:
-        return {kind: {"padded_lanes": pad[0], "total_lanes": pad[1],
-                       "waste_ratio": round(pad[0] / pad[1], 4) if pad[1]
-                       else 0.0}
-                for kind, pad in sorted(_padding.items())}
+        out = {}
+        for kind, pad in sorted(_padding.items()):
+            entry = {"padded_lanes": pad[0], "total_lanes": pad[1],
+                     "waste_ratio": round(pad[0] / pad[1], 4) if pad[1]
+                     else 0.0}
+            ph = _phase_totals.get(kind)
+            if ph is not None:
+                span = ph[0] + ph[1]
+                entry["transfer_s"] = round(ph[0], 6)
+                entry["device_s"] = round(ph[1], 6)
+                entry["transfer_fraction"] = (round(ph[0] / span, 4)
+                                              if span > 0 else 0.0)
+            out[kind] = entry
+        return out
 
 
 def clear() -> None:
@@ -116,3 +142,4 @@ def clear() -> None:
     with _lock:
         _records.clear()
         _padding.clear()
+        _phase_totals.clear()

@@ -1,0 +1,45 @@
+"""bench.py smoke: the harness must stay unattended-safe (BENCH_r05
+regression: a mid-run backend failure exited 1 instead of falling back).
+
+Runs the fastest config end-to-end in a subprocess pinned to the CPU
+backend and asserts rc=0 plus a well-formed two-line artifact (detail
+first, line-of-record last) including the streamed on/off A/B numbers."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_prio3count_exits_zero():
+    env = dict(os.environ,
+               BENCH_SMOKE="1",
+               BENCH_CONFIGS="Prio3Count",
+               BENCH_WORKERS="4",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"bench exited {proc.returncode}\nstdout:\n{proc.stdout[-2000:]}"
+        f"\nstderr:\n{proc.stderr[-2000:]}")
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) >= 2, proc.stdout[-2000:]
+    detail = json.loads(lines[-2])["detail"]
+    record = json.loads(lines[-1])
+    assert record["backend"] == "cpu"
+    assert record["smoke"] is True
+    cfg = detail["Prio3Count"]
+    assert "error" not in cfg, cfg
+    assert cfg["reports_per_sec"] > 0
+    # the streamed on/off A/B runs on the concurrent path and prints both
+    assert "concurrent_reports_per_sec" in cfg
+    assert "concurrent_reports_per_sec_unstreamed" in cfg
+    assert cfg["failed_lanes_warmup"] == 0

@@ -1,0 +1,301 @@
+"""Streaming prepare data plane (engine/streaming.py + the BatchPrio3
+streamed dispatch): byte parity of the streamed/chunked plane against the
+pre-streaming single-launch plane, device-resident aggregation against the
+sequential host oracle, and the link-adaptive sizing policy.
+
+The parity tests are the acceptance spine: double-buffered chunking and
+HBM-resident output shares are pure data-movement changes, so statuses,
+outbound messages and aggregates must be bit-identical however the launch
+was decomposed."""
+
+import numpy as np
+import pytest
+
+from janus_tpu.engine import streaming
+from janus_tpu.engine.batch import BatchPrio3, LaneRef, bucket_size
+from janus_tpu.engine.host import HostPrepEngine
+from janus_tpu.models import VdafInstance
+from janus_tpu.models.vdaf_instance import vdaf_for_instance
+from janus_tpu.vdaf import ping_pong as pp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_link():
+    """The module-level estimator is process-wide state; tests must not
+    leak observations into each other (or into later test files)."""
+    streaming.LINK.reset()
+    yield
+    streaming.LINK.reset()
+
+
+def _mk_reports(vdaf, verify_key, n, base=8):
+    nonces, pubs, shares, inits = [], [], [], []
+    for i in range(base):
+        nonce = i.to_bytes(16, "big")
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard(i % 2, nonce, rand)
+        _st, msg = pp.leader_initialized(vdaf, verify_key, nonce, pub,
+                                         ishares[0])
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares.append(vdaf.encode_input_share(1, ishares[1]))
+        inits.append(msg)
+    reps = n // base + 1
+    return ([x for x in nonces * reps][:n], [x for x in pubs * reps][:n],
+            [x for x in shares * reps][:n], [x for x in inits * reps][:n])
+
+
+def _mk_leader_reports(vdaf, n, base=8):
+    nonces, pubs, shares = [], [], []
+    for i in range(base):
+        nonce = i.to_bytes(16, "big")
+        rand = bytes((i + j) % 256 for j in range(vdaf.RAND_SIZE))
+        pub, ishares = vdaf.shard(i % 2, nonce, rand)
+        nonces.append(nonce)
+        pubs.append(vdaf.encode_public_share(pub))
+        shares.append(vdaf.encode_input_share(0, ishares[0]))
+    reps = n // base + 1
+    return ([x for x in nonces * reps][:n], [x for x in pubs * reps][:n],
+            [x for x in shares * reps][:n])
+
+
+# -- link estimator ---------------------------------------------------------
+
+
+def test_estimator_ewma_and_latency_floor():
+    e = streaming.LinkBandwidthEstimator(alpha=0.3)
+    assert e.up_bps() is None and e.down_bps() is None
+    e.record_up(2**20, 1.0)
+    assert e.up_bps() == pytest.approx(2**20)
+    e.record_up(2**20, 0.5)  # 2 MiB/s observation folds in at alpha=0.3
+    assert e.up_bps() == pytest.approx(0.3 * 2 * 2**20 + 0.7 * 2**20)
+    # tiny transfers measure RTT latency, not bandwidth: ignored
+    before = e.up_bps()
+    e.record_up(1024, 10.0)
+    assert e.up_bps() == before
+    e.record_down(2**20, 2.0)
+    assert e.down_bps() == pytest.approx(2**19)
+    snap = e.snapshot()
+    assert snap["observations"] == 3
+    assert snap["up_bytes_per_sec"] == pytest.approx(before, rel=1e-3)
+
+
+def test_estimator_seed_installs_probe():
+    e = streaming.LinkBandwidthEstimator()
+    e.seed(5e6, 7e6)
+    assert e.up_bps() == pytest.approx(5e6)
+    assert e.down_bps() == pytest.approx(7e6)
+    # real observations fold against the seed rather than replacing it
+    e.record_up(2**20, 1.0)
+    assert e.up_bps() < 5e6
+
+
+# -- adaptive chunk plan ----------------------------------------------------
+
+
+def test_adaptive_plan_requires_an_estimate():
+    e = streaming.LinkBandwidthEstimator()
+    assert streaming.adaptive_chunk_plan(24576, 1150, e) is None
+
+
+def test_adaptive_plan_slow_link_chunks_on_grid():
+    e = streaming.LinkBandwidthEstimator()
+    e.record_up(10 * 2**20, 1.0)  # ~10 MiB/s: 24576x1150B uploads in ~2.7s
+    plan = streaming.adaptive_chunk_plan(24576, 1150, e)
+    assert plan == [6144] * 4  # MAX_CHUNKS even splits, on the bucket grid
+    assert sum(plan) >= 24576
+    assert all(c == bucket_size(c) for c in plan)
+
+
+def test_adaptive_plan_fast_link_single_launch():
+    e = streaming.LinkBandwidthEstimator()
+    e.record_up(2**30, 1.0)  # ~1 GiB/s: upload hides behind one kernel
+    assert streaming.adaptive_chunk_plan(24576, 1150, e) is None
+
+
+def test_adaptive_plan_small_batch_never_chunks():
+    e = streaming.LinkBandwidthEstimator()
+    e.record_up(2**20, 1.0)  # pathologically slow
+    assert streaming.adaptive_chunk_plan(4096, 1150, e,
+                                         min_chunk=8192) is None
+
+
+def test_recommend_coalesce_params():
+    # no estimate: hand back the caller's defaults untouched
+    e = streaming.LinkBandwidthEstimator()
+    assert streaming.recommend_coalesce_params(e, 1150) == (16384, 4.0)
+    # slow link: smaller launches (chunkable/overlappable), longer window
+    e.record_up(10 * 2**20, 1.0)
+    mb_slow, delay_slow = streaming.recommend_coalesce_params(e, 1150)
+    assert 1024 <= mb_slow < 16384
+    assert mb_slow == bucket_size(mb_slow)
+    assert 1.0 <= delay_slow <= 16.0
+    # fast link: big launches for dispatch amortization, minimal window
+    f = streaming.LinkBandwidthEstimator()
+    f.record_up(2**31, 1.0)
+    mb_fast, delay_fast = streaming.recommend_coalesce_params(f, 1150)
+    assert mb_fast == 65536
+    assert delay_fast == 1.0
+    assert mb_fast > mb_slow
+
+
+def test_chunk_plan_uses_link_estimate():
+    """The engine's own _chunk_plan consults the process-wide estimator
+    when streaming (no env override, no fixed flag)."""
+    eng = BatchPrio3(vdaf_for_instance(VdafInstance.prio3_sum_vec(
+        length=1000, bits=1, chunk_length=32)))
+    eng.streaming = True
+    eng.chunked_dispatch = False
+    eng._chunk_override = 0
+    assert eng._chunk_plan(24576) is None  # no estimate yet
+    streaming.LINK.record_up(10 * 2**20, 1.0)
+    plan = eng._chunk_plan(24576)
+    assert plan is not None and len(plan) > 1
+    assert sum(plan) >= 24576
+
+
+# -- byte parity: streamed/chunked vs pre-streaming single launch -----------
+
+
+def test_streamed_chunked_matches_unstreamed_helper():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    n = 300
+    nonces, pubs, shares, inits = _mk_reports(vdaf, vk, n)
+    # tamper lanes in different chunks so failures cross chunk boundaries
+    shares = list(shares)
+    shares[5] = shares[5][:-1] + bytes([shares[5][-1] ^ 1])
+    shares[200] = b""
+    inits = list(inits)
+
+    streamed = BatchPrio3(vdaf)
+    streamed.streaming = True
+    streamed._chunk_override = 64  # force the double-buffered path at n=300
+    plain = BatchPrio3(vdaf)
+    plain.streaming = False  # the pre-streaming host-bounce data plane
+    plain._chunk_override = 0
+    assert streamed._chunk_plan(n) is not None
+    assert plain._chunk_plan(n) is None
+
+    rc = streamed.helper_init_batch(vk, nonces, pubs, shares, inits)
+    rs = plain.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert [r.status for r in rc] == [r.status for r in rs]
+    assert [r.outbound.encode() if r.outbound else None for r in rc] == \
+           [r.outbound.encode() if r.outbound else None for r in rs]
+    # streamed reports carry the HBM-resident handle; unstreamed do not
+    fin = [i for i, r in enumerate(rc) if r.status == "finished"]
+    assert fin
+    assert all(rc[i].device_shares is not None and rc[i].lane == i
+               for i in fin)
+    assert all(rs[i].device_shares is None for i in fin)
+    # aggregates are bit-identical across the two data planes
+    assert streamed.aggregate(rc) == plain.aggregate(rs)
+
+
+def test_streamed_matches_unstreamed_leader():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    n = 200
+    nonces, pubs, shares = _mk_leader_reports(vdaf, n)
+    shares = list(shares)
+    shares[7] = b"\x00"  # bad length -> failed lane
+
+    streamed = BatchPrio3(vdaf)
+    streamed.streaming = True
+    streamed._chunk_override = 64
+    plain = BatchPrio3(vdaf)
+    plain.streaming = False
+    plain._chunk_override = 0
+    assert streamed._chunk_plan(n, kind="leader") is not None
+
+    rc = streamed.leader_init_batch(vk, nonces, pubs, shares)
+    rs = plain.leader_init_batch(vk, nonces, pubs, shares)
+    assert [r.status for r in rc] == [r.status for r in rs]
+    assert [r.prep_share for r in rc] == [r.prep_share for r in rs]
+    assert [r.outbound.encode() if r.outbound else None for r in rc] == \
+           [r.outbound.encode() if r.outbound else None for r in rs]
+    good = [i for i, r in enumerate(rc) if r.status == "continued"]
+    assert good
+    rows_c = [rc[i].out_share_raw for i in good]
+    rows_s = [rs[i].out_share_raw for i in good]
+    assert streamed.aggregate_raw_rows(rows_c) == \
+        plain.aggregate_raw_rows(rows_s)
+
+
+# -- HBM-resident aggregation vs the sequential host oracle -----------------
+
+
+def test_device_resident_aggregate_matches_host_oracle():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    n = 40
+    nonces, pubs, shares, inits = _mk_reports(vdaf, vk, n)
+    eng = BatchPrio3(vdaf)
+    eng.streaming = True
+    rc = eng.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert all(r.status == "finished" for r in rc)
+    # every lane references ONE resident batch tensor (no per-lane copies)
+    assert all(r.device_shares is rc[0].device_shares for r in rc)
+
+    host = HostPrepEngine(vdaf)
+    rh = host.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert all(r.status == "finished" for r in rh)
+    assert eng.aggregate(rc) == host.aggregate(rh)
+
+
+def test_grouped_raw_rows_mix_device_and_host():
+    """aggregate_raw_rows partitions: handles into two distinct resident
+    batches reduce on device, loose host rows take the upload path, and
+    the combination is bit-identical to the sequential host fold."""
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    eng = BatchPrio3(vdaf)
+    eng.streaming = True
+    n1, n2 = 24, 16
+    a_in = _mk_reports(vdaf, vk, n1)
+    b_in = _mk_reports(vdaf, vk, n2, base=4)
+    ra = eng.helper_init_batch(vk, *a_in)
+    rb = eng.helper_init_batch(vk, *b_in)
+    assert ra[0].device_shares is not rb[0].device_shares
+
+    rows = [r.out_share_raw for r in ra] + [r.out_share_raw for r in rb]
+    # plus two host-resident rows (materialized copies of lanes 0 and 3)
+    rows += [np.asarray(ra[0].out_share_raw), np.asarray(rb[3].out_share_raw)]
+    got = eng.aggregate_raw_rows(rows)
+
+    host = HostPrepEngine(vdaf)
+    expect = host.aggregate_raw_rows([np.asarray(r) for r in rows])
+    assert got == expect
+
+
+def test_raw_rows_duplicate_lane_falls_back_to_host():
+    """A repeated lane can't be a 0/1 mask; the group must still aggregate
+    correctly (it materializes on the host)."""
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    eng = BatchPrio3(vdaf)
+    eng.streaming = True
+    rc = eng.helper_init_batch(vk, *_mk_reports(vdaf, vk, 12, base=4))
+    rows = [r.out_share_raw for r in rc] + [rc[2].out_share_raw]
+    got = eng.aggregate_raw_rows(rows)
+    host = HostPrepEngine(vdaf)
+    assert got == host.aggregate_raw_rows([np.asarray(r) for r in rows])
+
+
+def test_transfer_split_reaches_profiler():
+    """Streamed launches attribute upload+fetch to the transfer phase so
+    /debug/profile can split transfer from compute."""
+    from janus_tpu import profiler
+
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    eng = BatchPrio3(vdaf)
+    eng.streaming = True
+    profiler.clear()
+    eng.helper_init_batch(vk, *_mk_reports(vdaf, vk, 16))
+    recs = [r for r in profiler.snapshot() if r["kind"] == "helper_init"]
+    assert recs
+    assert "transfer_s" in recs[-1]["phases"]
+    summ = profiler.summary()["helper_init"]
+    assert "transfer_fraction" in summ
+    assert 0.0 <= summ["transfer_fraction"] <= 1.0
