@@ -1,0 +1,281 @@
+"""janus-lint: repo-wide AST static analysis for the janus_tpu data plane.
+
+The reference implementation (janus, PAPER.md §0) leans on Rust's compiler
+and sanitizers for its concurrency and crypto guarantees; this Python/JAX
+port has neither, and its surface — dispatcher threads, process-wide
+singletons, jitted hot paths, constant-time crypto — is exactly where
+convention rots.  janus-lint encodes the repo's correctness conventions as
+three checker families that run over the AST of every module:
+
+- ``locks``      lock discipline: guarded-attribute access outside the
+                 guarding ``with``-lock block, and lock-acquisition-order
+                 inversions across the whole repo.
+- ``jitpurity``  jit purity / host sync: implicit device->host syncs and
+                 Python side effects inside ``jax.jit``-ed kernels,
+                 unstable-hash static args, and blocking syncs on the
+                 engine/ops/vdaf hot paths.
+- ``crypto``     crypto hygiene: variable-time ``==`` on MAC/tag/seed
+                 material, secret-dependent branching in the crypto cores,
+                 float arithmetic touching field-limb tensors.
+
+Run it as ``python -m janus_lint`` (exit 0 = clean) or through the tier-1
+suite (tests/test_janus_lint.py).  See docs/STATIC_ANALYSIS.md.
+
+Suppressions
+------------
+
+Intentional exceptions are suppressed inline, with a *required*
+justification after ``--``::
+
+    ok = jnp.all(tag == want, axis=-1)  # janus-lint: disable=nonconstant-compare -- device-wide lane mask, data-independent schedule
+
+A suppression comment on its own line applies to the next line.  A
+suppression without a justification is itself a finding
+(``suppression-needs-reason``), so the repo cannot silently accumulate
+unexplained exceptions.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from io import StringIO
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "RULES",
+    "lint_source",
+    "lint_paths",
+    "iter_py_files",
+]
+
+# rule-id -> one-line description (docs/STATIC_ANALYSIS.md holds the prose)
+RULES = {
+    # locks
+    "guarded-write-unlocked": (
+        "attribute guarded by a lock elsewhere is written outside a "
+        "with-lock block"),
+    "guarded-read-unlocked": (
+        "attribute guarded by a lock elsewhere is read outside a "
+        "with-lock block"),
+    "lock-order-inversion": (
+        "two locks are acquired in opposite nesting orders somewhere in "
+        "the repo (deadlock hazard)"),
+    # jit purity / host sync
+    "jit-host-sync": (
+        "implicit device->host synchronization (.item(), float()/int()/"
+        "np.asarray on a traced argument, block_until_ready) inside a "
+        "jax.jit-ed function"),
+    "jit-side-effect": (
+        "Python side effect (print, global/nonlocal write, attribute "
+        "mutation of an argument) inside a jax.jit-ed function"),
+    "jit-unstable-static": (
+        "static_argnums/static_argnames names a parameter whose default "
+        "is an unhashable literal (retrace storm / TypeError at call "
+        "time)"),
+    "hot-path-sync": (
+        "blocking device sync (.item(), block_until_ready, device_get) "
+        "on the engine/ops/vdaf hot path outside a jitted kernel; "
+        "justify the sync boundary or split it"),
+    # crypto hygiene
+    "nonconstant-compare": (
+        "==/!= on MAC/tag/digest/seed material; use hmac.compare_digest"),
+    "secret-branch": (
+        "control flow branches on secret material in a constant-time "
+        "crypto core"),
+    "float-in-field": (
+        "float arithmetic (true division, float dtype) touching "
+        "field-limb tensors"),
+    # typing (only emitted when mypy is importable; see typecheck.py)
+    "mypy-strict": (
+        "mypy --strict diagnostic in janus_tpu/messages or janus_tpu/core"),
+    # meta
+    "suppression-needs-reason": (
+        "janus-lint suppression without a '-- <justification>' string"),
+    "unknown-rule-suppressed": (
+        "janus-lint suppression names a rule id that does not exist"),
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def format(self) -> str:
+        tail = f"  [suppressed: {self.justification}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{tail}"
+
+
+@dataclasses.dataclass
+class LintResult:
+    """Outcome of a lint run: `active` findings fail the run, `suppressed`
+    ones are carried for reporting."""
+
+    active: list[Finding] = dataclasses.field(default_factory=list)
+    suppressed: list[Finding] = dataclasses.field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.active
+
+    def extend(self, other: "LintResult") -> None:
+        self.active.extend(other.active)
+        self.suppressed.extend(other.suppressed)
+
+
+# -- suppressions ------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*janus-lint:\s*disable=([\w,-]+)(?:\s*--\s*(.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class _Suppression:
+    rules: tuple[str, ...]
+    reason: str
+    line: int          # line the comment sits on
+    own_line: bool     # comment-only line: applies to the next line too
+
+
+def _parse_suppressions(src: str, path: str) -> tuple[list[_Suppression],
+                                                      list[Finding]]:
+    sups: list[_Suppression] = []
+    meta: list[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(StringIO(src).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return sups, meta
+    code_lines: set[int] = set()
+    for tok in tokens:
+        if tok.type not in (tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE,
+                            tokenize.INDENT, tokenize.DEDENT,
+                            tokenize.ENCODING, tokenize.ENDMARKER):
+            for ln in range(tok.start[0], tok.end[0] + 1):
+                code_lines.add(ln)
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        line = tok.start[0]
+        sup = _Suppression(rules, reason, line, own_line=line not in code_lines)
+        sups.append(sup)
+        if not reason:
+            meta.append(Finding(
+                "suppression-needs-reason", path, line, tok.start[1],
+                f"suppression for {','.join(rules)} has no '-- <reason>' "
+                "justification"))
+        for r in rules:
+            if r not in RULES:
+                meta.append(Finding(
+                    "unknown-rule-suppressed", path, line, tok.start[1],
+                    f"suppression names unknown rule {r!r}"))
+    return sups, meta
+
+
+def _apply_suppressions(findings: list[Finding],
+                        sups: list[_Suppression]) -> LintResult:
+    by_line: dict[int, list[_Suppression]] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+        if s.own_line:
+            by_line.setdefault(s.line + 1, []).append(s)
+    res = LintResult()
+    for f in findings:
+        hit = None
+        for s in by_line.get(f.line, ()):
+            if f.rule in s.rules:
+                hit = s
+                break
+        if hit is not None:
+            f.suppressed = True
+            f.justification = hit.reason
+            res.suppressed.append(f)
+        else:
+            res.active.append(f)
+    return res
+
+
+# -- orchestration -----------------------------------------------------------
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules: set[str] | None = None,
+                _order_edges: list | None = None) -> LintResult:
+    """Lint one module's source.  `rules`, when given, keeps only those
+    rule ids (suppression-meta findings are always kept).  `_order_edges`
+    collects cross-module lock-order edges for the repo-level inversion
+    pass."""
+    from janus_lint import crypto, jitpurity, locks
+
+    sups, meta = _parse_suppressions(src, path)
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        res = LintResult()
+        res.active.append(Finding(
+            "jit-host-sync", path, e.lineno or 1, 0,
+            f"file does not parse: {e.msg}"))
+        return res
+    findings: list[Finding] = []
+    lock_findings, edges = locks.check_module(tree, path)
+    findings.extend(lock_findings)
+    if _order_edges is not None:
+        _order_edges.extend(edges)
+    findings.extend(jitpurity.check_module(tree, path))
+    findings.extend(crypto.check_module(tree, path))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    findings.extend(meta)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return _apply_suppressions(findings, sups)
+
+
+def lint_paths(paths: list[str],
+               rules: set[str] | None = None) -> LintResult:
+    """Lint every .py file under `paths`, then run the repo-level
+    lock-order inversion pass over the union of acquisition edges."""
+    from janus_lint import locks
+
+    result = LintResult()
+    edges: list = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        result.extend(lint_source(src, path, rules=rules,
+                                  _order_edges=edges))
+    order = locks.check_order(edges)
+    if rules is not None:
+        order = [f for f in order if f.rule in rules]
+    result.active.extend(order)  # repo-level: not line-suppressable
+    return result

@@ -1,0 +1,87 @@
+"""CLI: ``python -m janus_lint [paths ...]``.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 internal/usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from janus_lint import RULES, lint_paths
+from janus_lint import typecheck
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TARGETS = ("janus_tpu", "janus_lint")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="janus_lint",
+        description="janus-lint: lock discipline, jit purity, and crypto "
+                    "hygiene checks (docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: janus_tpu/ and "
+                         "janus_lint/)")
+    ap.add_argument("--rules", help="comma-separated rule ids to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-mypy", action="store_true",
+                    help="skip the mypy --strict pass over "
+                         "janus_tpu/{messages,core}")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}: {desc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or [os.path.join(REPO_ROOT, t)
+                           for t in DEFAULT_TARGETS]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    result = lint_paths(paths, rules=rules)
+
+    mypy_status = "disabled"
+    mypy_findings = []
+    if not args.no_mypy and not args.paths and rules is None:
+        mypy_findings, mypy_status = typecheck.run_mypy(REPO_ROOT)
+        result.active.extend(mypy_findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "active": [vars(f) for f in result.active],
+            "suppressed": [vars(f) for f in result.suppressed],
+            "mypy": mypy_status,
+        }, indent=2))
+        return 0 if result.clean else 1
+
+    for f in result.active:
+        print(f.format())
+    if args.show_suppressed:
+        for f in result.suppressed:
+            print(f.format())
+    n_files = "default targets" if not args.paths else f"{len(paths)} paths"
+    print(f"janus-lint: {len(result.active)} finding(s), "
+          f"{len(result.suppressed)} suppressed ({n_files}; "
+          f"mypy: {mypy_status})", file=sys.stderr)
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

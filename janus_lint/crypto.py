@@ -1,0 +1,181 @@
+"""Crypto-hygiene checkers.
+
+- ``nonconstant-compare`` (repo-wide): ``==``/``!=`` where either operand
+  names MAC/tag/digest/checksum/seed material.  Python's bytes equality
+  short-circuits on the first differing byte — a timing oracle on
+  authenticators; use ``hmac.compare_digest``.  Comparisons against string
+  literals or numbers are exempt (kind switches, length checks), as are
+  identifiers whose trailing segment marks them as metadata
+  (``*_type``, ``*_len``, ``*_size``, ...).
+
+- ``secret-branch`` (crypto cores only: ``core/hpke.py``,
+  ``core/softcrypto.py``, ``ops/field*.py``, ``ops/hmac_aes.py``,
+  ``ops/gcm.py``, ``ops/x25519.py``): an ``if``/``while``/ternary whose
+  condition reads a secret-named value (``sk``/``secret``/``plaintext``/
+  ``blind``...) outside a ``len()``/``isinstance()`` shape check.  Branch
+  predictors leak; constant-time cores select with masks.
+
+- ``float-in-field`` (field-limb modules): true division or float dtypes
+  in field arithmetic.  Field elements are exact integers in 32-bit
+  limbs; one float round-trip silently corrupts limbs above 2^24.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from janus_lint import Finding
+
+# identifier segments that mark authenticator material
+_AUTH_SEGMENTS = {"tag", "mac", "digest", "checksum", "hmac", "signature",
+                  "sig", "seed", "token"}
+# trailing segments that mark metadata about the value, not the value
+_META_TAIL = {"type", "kind", "id", "len", "size", "count", "idx", "index",
+              "offset", "off", "name", "names", "field", "prefix", "err",
+              "error", "ok"}
+
+_SECRET_SEGMENTS = {"sk", "secret", "plaintext", "blind", "priv", "private"}
+
+_FIELD_FILE_RE = re.compile(r"(^|/)(field\d+\w*)\.py$")
+_SECRET_SCOPE_RE = re.compile(
+    r"(^|/)core/(hpke|softcrypto)\.py$|"
+    r"(^|/)ops/(field\d+\w*|hmac_aes|gcm|x25519)\.py$")
+
+_FLOAT_DTYPES = {"float16", "float32", "float64", "bfloat16", "float_",
+                 "double", "half"}
+_SHAPE_FNS = {"len", "isinstance", "type", "hasattr", "getattr", "id",
+              "sorted", "range", "enumerate"}
+
+
+def _segments(name: str) -> list[str]:
+    return [s for s in name.lower().split("_") if s]
+
+
+def _operand_name(node: ast.expr) -> str | None:
+    """Identifier of a compare operand: last attribute segment or name."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _operand_name(node.value)
+    return None
+
+
+def _names_auth_material(node: ast.expr) -> str | None:
+    name = _operand_name(node)
+    if name is None:
+        return None
+    if name.isupper():
+        return None  # SCREAMING_SNAKE: a compile-time constant (type
+        # codes, enum members), not authenticator material
+    segs = _segments(name)
+    if not segs or segs[-1] in _META_TAIL:
+        return None
+    if any(s in _AUTH_SEGMENTS for s in segs):
+        return name
+    return None
+
+
+def _is_exempt_operand(node: ast.expr) -> bool:
+    """Literals: a kind-switch against 'Prio3...' or a length constant."""
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (str, int, float)) and node.value is not None:
+        return not isinstance(node.value, bytes)
+    return False
+
+
+def _check_compares(tree: ast.Module, path: str,
+                    findings: list[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not all(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            continue
+        operands = [node.left] + list(node.comparators)
+        if any(_is_exempt_operand(o) for o in operands):
+            continue
+        if any(isinstance(o, ast.Constant) and o.value is None
+               for o in operands):
+            continue  # `x is None` style written with == is not a timing leak
+        for o in operands:
+            name = _names_auth_material(o)
+            if name is not None:
+                findings.append(Finding(
+                    "nonconstant-compare", path, node.lineno,
+                    node.col_offset,
+                    f"==/!= on {name!r} short-circuits per byte (timing "
+                    "oracle on authenticator material); use "
+                    "hmac.compare_digest"))
+                break
+
+
+def _condition_secret(node: ast.expr) -> str | None:
+    """Secret-named identifier read in a branch condition, ignoring
+    reads inside shape/type calls like len(sk)."""
+    shape_call_nodes: set[int] = set()
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id in _SHAPE_FNS):
+            shape_call_nodes.update(id(s) for s in ast.walk(sub))
+    for sub in ast.walk(node):
+        if id(sub) in shape_call_nodes:
+            continue
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            name = _operand_name(sub)
+            if name is None:
+                continue
+            segs = _segments(name)
+            if segs and segs[-1] not in _META_TAIL and any(
+                    s in _SECRET_SEGMENTS for s in segs):
+                return name
+    return None
+
+
+def _check_secret_branches(tree: ast.Module, path: str,
+                           findings: list[Finding]) -> None:
+    for node in ast.walk(tree):
+        cond = None
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            cond = node.test
+        elif isinstance(node, ast.Assert):
+            cond = node.test
+        if cond is None:
+            continue
+        name = _condition_secret(cond)
+        if name is not None:
+            findings.append(Finding(
+                "secret-branch", path, cond.lineno, cond.col_offset,
+                f"branch condition reads secret {name!r}; constant-time "
+                "code selects with masks, not control flow"))
+
+
+def _check_float_field(tree: ast.Module, path: str,
+                       findings: list[Finding]) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            findings.append(Finding(
+                "float-in-field", path, node.lineno, node.col_offset,
+                "true division in a field-limb module produces floats; "
+                "field arithmetic is exact (// or modular inverse)"))
+        elif isinstance(node, ast.Attribute) and node.attr in _FLOAT_DTYPES:
+            findings.append(Finding(
+                "float-in-field", path, node.lineno, node.col_offset,
+                f"float dtype .{node.attr} in a field-limb module; limbs "
+                "above 2^24 lose bits in float32 mantissas"))
+        elif isinstance(node, ast.Constant) and node.value in _FLOAT_DTYPES:
+            findings.append(Finding(
+                "float-in-field", path, node.lineno, node.col_offset,
+                f"float dtype {node.value!r} in a field-limb module"))
+
+
+def check_module(tree: ast.Module, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    norm = path.replace("\\", "/")
+    _check_compares(tree, norm, findings)
+    if _SECRET_SCOPE_RE.search(norm):
+        _check_secret_branches(tree, norm, findings)
+    if _FIELD_FILE_RE.search(norm) and "/ops/" in norm:
+        _check_float_field(tree, norm, findings)
+    return findings

@@ -1,0 +1,232 @@
+"""jit-purity / host-sync checkers.
+
+Jitted functions are found two ways, matching this repo's idiom:
+``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators, and local ``def
+kernel(...)`` later wrapped as ``jax.jit(kernel, ...)`` in the same
+module (engine/batch.py, ops/hpke_device.py).
+
+Inside a jitted body everything is a tracer, so:
+
+- ``jit-host-sync``    ``.item()``, ``.block_until_ready()``, and
+  ``np.*``/``float()``/``int()``/``bool()`` conversions applied to an
+  expression that mentions a parameter of the jitted function (host
+  conversions of *constants* at trace time are fine and common).
+- ``jit-side-effect``  ``print(...)``, ``global``/``nonlocal``
+  statements, writes to an attribute of a parameter: they run once per
+  trace, not once per call — silent misbehavior after caching.
+- ``jit-unstable-static``  a ``static_argnums``/``static_argnames``
+  parameter whose default is an unhashable literal (list/dict/set):
+  every call either TypeErrors or retraces.
+
+Outside jitted bodies, on the hot-path packages (``engine/``, ``ops/``,
+``vdaf/``):
+
+- ``hot-path-sync``    ``.item()`` / ``block_until_ready`` /
+  ``jax.device_get`` force a device round-trip; each site must be a
+  deliberate, justified sync boundary (suppress with the reason) or be
+  split/moved off the hot path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from janus_lint import Finding
+
+_HOT_DIRS = ("/engine/", "/ops/", "/vdaf/")
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_NP_CONVERTERS = {"asarray", "array", "frombuffer", "copy", "float32",
+                  "float64", "int32", "int64", "uint32", "uint64"}
+_PY_CONVERTERS = {"float", "int", "bool", "complex"}
+
+
+def _dotted(node: ast.expr) -> str | None:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _jitted_defs(tree: ast.Module):
+    """-> {def-node-id: (def, static_argnums, static_argnames)} for every
+    function the module jits, plus the jit Call node per def when wrapped
+    via jax.jit(name, ...)."""
+    defs: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    jitted: dict[int, tuple] = {}
+
+    def record(fn, static_nums, static_names):
+        jitted[id(fn)] = (fn, static_nums, static_names)
+
+    def static_kwargs(call: ast.Call):
+        nums: list[int] = []
+        names: list[str] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, int):
+                        nums.append(sub.value)
+            elif kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str):
+                        names.append(sub.value)
+        return nums, names
+
+    for node in ast.walk(tree):
+        # jax.jit(kernel, ...) wrapping a local def
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in defs:
+                nums, names = static_kwargs(node)
+                for fn in defs[target.id]:
+                    record(fn, nums, names)
+        # decorator forms
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec):
+                    record(node, [], [])
+                elif (isinstance(dec, ast.Call)
+                      and (_is_jax_jit(dec.func)
+                           or (_dotted(dec.func) == "partial" and dec.args
+                               and _is_jax_jit(dec.args[0])))):
+                    nums, names = static_kwargs(dec)
+                    record(node, nums, names)
+    return jitted
+
+
+def _param_names(fn) -> set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _mentions(node: ast.expr, names: set[str]) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in names
+               for sub in ast.walk(node))
+
+
+def _check_jitted_body(fn, static_nums, static_names, path,
+                       findings: list[Finding]) -> None:
+    params = _param_names(fn)
+    ordered = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+    traced = set(params)
+    for i in static_nums:
+        if 0 <= i < len(ordered):
+            traced.discard(ordered[i])
+    traced -= set(static_names)
+
+    # unstable static defaults
+    defaults = fn.args.defaults
+    if defaults:
+        tail = ordered[len(ordered) - len(defaults):]
+        for pname, dflt in zip(tail, defaults):
+            is_static = pname in static_names or (
+                ordered.index(pname) in static_nums)
+            if is_static and isinstance(dflt, (ast.List, ast.Dict, ast.Set)):
+                findings.append(Finding(
+                    "jit-unstable-static", path, dflt.lineno,
+                    dflt.col_offset,
+                    f"static arg {pname!r} of jitted {fn.name}() defaults "
+                    "to an unhashable literal"))
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue  # nested defs inherit tracedness; still scanned below
+        if isinstance(node, ast.Call):
+            fnode = node.func
+            if isinstance(fnode, ast.Attribute):
+                if fnode.attr in _SYNC_ATTRS and not node.args:
+                    findings.append(Finding(
+                        "jit-host-sync", path, node.lineno, node.col_offset,
+                        f".{fnode.attr}() inside jitted {fn.name}() forces "
+                        "a device->host sync on a tracer"))
+                    continue
+                dotted = _dotted(fnode)
+                if (dotted and dotted.split(".")[0] in ("np", "numpy")
+                        and fnode.attr in _NP_CONVERTERS and node.args
+                        and _mentions(node.args[0], traced)):
+                    findings.append(Finding(
+                        "jit-host-sync", path, node.lineno, node.col_offset,
+                        f"np.{fnode.attr}() on traced value inside jitted "
+                        f"{fn.name}() (ConcretizationTypeError or silent "
+                        "host sync)"))
+            elif isinstance(fnode, ast.Name):
+                if fnode.id in _PY_CONVERTERS and node.args and _mentions(
+                        node.args[0], traced):
+                    findings.append(Finding(
+                        "jit-host-sync", path, node.lineno, node.col_offset,
+                        f"{fnode.id}() on traced value inside jitted "
+                        f"{fn.name}()"))
+                elif fnode.id == "print":
+                    findings.append(Finding(
+                        "jit-side-effect", path, node.lineno,
+                        node.col_offset,
+                        f"print() inside jitted {fn.name}() runs at trace "
+                        "time only (use jax.debug.print)"))
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            findings.append(Finding(
+                "jit-side-effect", path, node.lineno, node.col_offset,
+                f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                f" write inside jitted {fn.name}() happens once per trace, "
+                "not per call"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in params):
+                    findings.append(Finding(
+                        "jit-side-effect", path, t.lineno, t.col_offset,
+                        f"attribute write {t.value.id}.{t.attr} inside "
+                        f"jitted {fn.name}() mutates host state at trace "
+                        "time only"))
+
+
+def check_module(tree: ast.Module, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    jitted = _jitted_defs(tree)
+    jitted_nodes = set()
+    for fn, nums, names in jitted.values():
+        jitted_nodes.update(id(sub) for sub in ast.walk(fn))
+        _check_jitted_body(fn, nums, names, path, findings)
+
+    norm = path.replace("\\", "/")
+    if any(d in norm for d in _HOT_DIRS):
+        for node in ast.walk(tree):
+            if id(node) in jitted_nodes:
+                continue
+            if isinstance(node, ast.Call):
+                fnode = node.func
+                if (isinstance(fnode, ast.Attribute)
+                        and fnode.attr in _SYNC_ATTRS and not node.args):
+                    findings.append(Finding(
+                        "hot-path-sync", path, node.lineno, node.col_offset,
+                        f".{fnode.attr}() on the hot path blocks the host "
+                        "on the device queue; justify the sync boundary"))
+                elif _dotted(fnode) in ("jax.device_get",
+                                        "jax.block_until_ready"):
+                    findings.append(Finding(
+                        "hot-path-sync", path, node.lineno, node.col_offset,
+                        f"{_dotted(fnode)}() on the hot path blocks the "
+                        "host on the device queue; justify the sync "
+                        "boundary"))
+    return findings

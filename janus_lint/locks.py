@@ -1,0 +1,377 @@
+"""Lock-discipline checkers.
+
+A *guarded attribute* is one the module itself treats as lock-protected:
+it is written at least once inside a ``with self._lock:`` (or module-level
+``with _lock:``) block.  Once an attribute is in that registry, every
+other access must follow the same discipline:
+
+- ``guarded-write-unlocked``  a write/mutation of a guarded attribute
+  outside a with-block holding the guarding lock.
+- ``guarded-read-unlocked``   a read of a guarded *instance* attribute
+  outside the lock (module globals are write-checked only: read-mostly
+  module state like cached library handles is conventionally published
+  once under the lock and read freely afterwards).
+- ``lock-order-inversion``    repo-level: two locks acquired in opposite
+  nesting orders anywhere in the codebase (deadlock hazard).
+
+Conventions the checker understands (documented in
+docs/STATIC_ANALYSIS.md):
+
+- ``__init__``/``__new__`` bodies are construction-time single-threaded:
+  they register guards but never violate them.
+- A function whose name ends in ``_locked`` asserts "caller holds the
+  lock" and is skipped (the call *sites* are still checked).
+- Nested functions (closures) are analyzed with an empty held-lock set:
+  a closure created under a lock generally outlives the critical section.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from janus_lint import Finding
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "add", "discard", "setdefault", "sort", "reverse",
+    "appendleft", "popleft",
+}
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    """True for threading.Lock() / threading.RLock() / Condition(...)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    return name in _LOCK_CTORS
+
+
+def _self_attr(node: ast.expr, selfname: str) -> str | None:
+    """attr name when `node` is `<selfname>.<attr>`, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == selfname):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.locks: set[str] = set()
+        # attr -> set of lock attrs it was written under
+        self.guarded: dict[str, set[str]] = {}
+
+
+def _first_param(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
+    args = fn.args.posonlyargs + fn.args.args
+    return args[0].arg if args else None
+
+
+def _with_locks(stmt: ast.With | ast.AsyncWith, selfname: str | None,
+                module_locks: set[str]) -> list[tuple[str, bool]]:
+    """Lock names acquired by this with statement: (name, is_module)."""
+    out = []
+    for item in stmt.items:
+        ctx = item.context_expr
+        if selfname is not None:
+            attr = _self_attr(ctx, selfname)
+            if attr is not None:
+                out.append((attr, False))
+                continue
+        if isinstance(ctx, ast.Name) and ctx.id in module_locks:
+            out.append((ctx.id, True))
+    return out
+
+
+def _walk_function(fn, selfname, module_locks, on_access, on_edge,
+                   held: frozenset):
+    """Drive `on_access(node, attr, kind, held)` for every guarded-candidate
+    access, tracking which locks are held.  kind: 'write' | 'read'.
+    `attr` is ('self', name) or ('global', name)."""
+
+    def visit_expr_reads(node, held, skip: set[int]):
+        for sub in ast.walk(node):
+            if id(sub) in skip:
+                continue
+            if selfname is not None:
+                a = _self_attr(sub, selfname)
+                if a is not None and isinstance(sub.ctx, ast.Load):
+                    # self.X.append(...) is handled as a write by the caller
+                    on_access(sub, ("self", a), "read", held)
+
+    def target_writes(tgt, held):
+        """Assignment target: record writes, return node ids consumed."""
+        consumed: set[int] = set()
+        for sub in ast.walk(tgt):
+            if selfname is not None:
+                a = _self_attr(sub, selfname)
+                if a is not None and isinstance(sub.ctx, (ast.Store, ast.Del)):
+                    on_access(sub, ("self", a), "write", held)
+                    consumed.add(id(sub))
+            if isinstance(sub, ast.Subscript):
+                base = sub.value
+                if selfname is not None:
+                    a = _self_attr(base, selfname)
+                    if a is not None:
+                        on_access(base, ("self", a), "write", held)
+                        consumed.add(id(base))
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                        (ast.Store, ast.Del)):
+                if sub.id in globals_declared:
+                    on_access(sub, ("global", sub.id), "write", held)
+        return consumed
+
+    globals_declared: set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Global):
+            globals_declared.update(sub.names)
+
+    def visit_stmts(stmts, held):
+        for st in stmts:
+            visit(st, held)
+
+    def visit(st, held):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closures escape the critical section: empty held set
+            _walk_function(st, selfname, module_locks, on_access, on_edge,
+                           frozenset())
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            acquired = _with_locks(st, selfname, module_locks)
+            for name, is_mod in acquired:
+                for h in held:
+                    on_edge(h, (name, is_mod), st)
+            new_held = held | {(n, m) for n, m in acquired}
+            for item in st.items:
+                visit_expr_reads(item.context_expr, held, set())
+            visit_stmts(st.body, new_held)
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            consumed: set[int] = set()
+            for t in targets:
+                consumed |= target_writes(t, held)
+            if isinstance(st, ast.AugAssign):
+                # x += 1 also reads x; the write call above covers the racy
+                # read-modify-write as one finding
+                pass
+            if st.value is not None:
+                visit_expr_reads(st.value, held, consumed)
+            for t in targets:
+                for sub in ast.walk(t):
+                    if id(sub) not in consumed and isinstance(
+                            sub, ast.expr) and isinstance(
+                                getattr(sub, "ctx", None), ast.Load):
+                        pass  # index expressions: reads handled below
+                visit_expr_reads(t, held, consumed | {
+                    id(s) for s in ast.walk(t)
+                    if isinstance(s, ast.Attribute)
+                    and isinstance(s.ctx, (ast.Store, ast.Del))})
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                target_writes(t, held)
+            return
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+            fnode = call.func
+            consumed: set[int] = set()
+            if (isinstance(fnode, ast.Attribute)
+                    and fnode.attr in _MUTATORS and selfname is not None):
+                a = _self_attr(fnode.value, selfname)
+                if a is not None:
+                    on_access(fnode.value, ("self", a), "write", held)
+                    consumed.add(id(fnode.value))
+            visit_expr_reads(call, held, consumed)
+            return
+        # generic statement: recurse into child statement lists with the
+        # same held set, and scan bare expressions for reads
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(st, field, None)
+            if sub:
+                visit_stmts(sub, held)
+        for h in getattr(st, "handlers", []) or []:
+            visit_stmts(h.body, held)
+        for field in ("test", "iter", "value", "exc", "msg", "cause"):
+            sub = getattr(st, field, None)
+            if isinstance(sub, ast.expr):
+                visit_expr_reads(sub, held, set())
+        if isinstance(st, ast.For):
+            target_writes(st.target, held)
+        if isinstance(st, ast.Return) and st.value is not None:
+            pass  # handled via "value" above
+
+    visit_stmts(fn.body, held)
+
+
+def _collect_class(cls: ast.ClassDef, module_locks: set[str]) -> _ClassInfo:
+    info = _ClassInfo(cls.name)
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # pass 1a: lock fields
+    for m in methods:
+        selfname = _first_param(m)
+        if selfname is None:
+            continue
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    a = _self_attr(t, selfname)
+                    if a is not None:
+                        info.locks.add(a)
+    # pass 1b: guarded registry — attrs written under a with-lock
+    for m in methods:
+        selfname = _first_param(m)
+        if selfname is None:
+            continue
+
+        def on_access(node, attr, kind, held, _info=info):
+            scope, name = attr
+            if scope != "self" or kind != "write":
+                return
+            for lock, is_mod in held:
+                if not is_mod and lock in _info.locks:
+                    _info.guarded.setdefault(name, set()).add(lock)
+
+        _walk_function(m, selfname, module_locks, on_access,
+                       lambda *a: None, frozenset())
+    # lock fields themselves are never "guarded data"
+    for lock in info.locks:
+        info.guarded.pop(lock, None)
+    return info
+
+
+def check_module(tree: ast.Module, path: str):
+    """-> (findings, lock-order edges).  Edges are
+    ((outer_id, inner_id, path, line)) with ids scoped to class/module."""
+    findings: list[Finding] = []
+    edges: list[tuple[str, str, str, int]] = []
+    modbase = os.path.splitext(os.path.basename(path))[0]
+
+    module_locks = {
+        t.id
+        for node in tree.body if isinstance(node, ast.Assign)
+        and _is_lock_ctor(node.value)
+        for t in node.targets if isinstance(t, ast.Name)
+    }
+
+    # module-level guarded globals: written under a module with-lock
+    guarded_globals: dict[str, set[str]] = {}
+
+    def scan_global_guards(fn):
+        def on_access(node, attr, kind, held):
+            scope, name = attr
+            if scope == "global" and kind == "write":
+                for lock, is_mod in held:
+                    if is_mod:
+                        guarded_globals.setdefault(name, set()).add(lock)
+
+        _walk_function(fn, _first_param(fn), module_locks, on_access,
+                       lambda *a: None, frozenset())
+
+    top_functions = [n for n in tree.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+    for fn in top_functions:
+        scan_global_guards(fn)
+
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+    infos = {id(c): _collect_class(c, module_locks) for c in classes}
+
+    def lock_id(cls_name: str | None, lock: str, is_mod: bool) -> str:
+        if is_mod:
+            return f"{modbase}.{lock}"
+        return f"{modbase}.{cls_name}.{lock}"
+
+    # pass 2: violations
+    def check_function(fn, selfname, info: _ClassInfo | None):
+        if fn.name in ("__init__", "__new__", "__del__"):
+            return
+        if fn.name.endswith("_locked"):
+            return
+
+        def on_access(node, attr, kind, held):
+            scope, name = attr
+            held_names = {lock for lock, is_mod in held
+                          if is_mod == (scope == "global")}
+            if scope == "self" and info is not None:
+                guards = info.guarded.get(name)
+                if not guards or guards & held_names:
+                    return
+                rule = ("guarded-write-unlocked" if kind == "write"
+                        else "guarded-read-unlocked")
+                lock_desc = "/".join(sorted(guards))
+                findings.append(Finding(
+                    rule, path, node.lineno, node.col_offset,
+                    f"{info.name}.{name} is guarded by self.{lock_desc} "
+                    f"elsewhere but {'written' if kind == 'write' else 'read'}"
+                    " here without it"))
+            elif scope == "global" and kind == "write":
+                guards = guarded_globals.get(name)
+                if not guards or guards & held_names:
+                    return
+                lock_desc = "/".join(sorted(guards))
+                findings.append(Finding(
+                    "guarded-write-unlocked", path, node.lineno,
+                    node.col_offset,
+                    f"module global {name} is guarded by {lock_desc} "
+                    "elsewhere but written here without it"))
+
+        def on_edge(outer, inner, stmt):
+            o_lock, o_mod = outer
+            i_lock, i_mod = inner
+            cls_name = info.name if info is not None else None
+            if not o_mod and (info is None or o_lock not in info.locks):
+                return
+            if not i_mod and (info is None or i_lock not in info.locks):
+                return
+            edges.append((lock_id(cls_name, o_lock, o_mod),
+                          lock_id(cls_name, i_lock, i_mod),
+                          path, stmt.lineno))
+
+        _walk_function(fn, selfname, module_locks, on_access, on_edge,
+                       frozenset())
+
+    for cls in classes:
+        info = infos[id(cls)]
+        if not info.locks and not guarded_globals:
+            continue
+        for m in cls.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_function(m, _first_param(m), info)
+    for fn in top_functions:
+        check_function(fn, _first_param(fn), None)
+
+    return findings, edges
+
+
+def check_order(edges: list[tuple[str, str, str, int]]) -> list[Finding]:
+    """Repo-level lock-order pass: a cycle in the acquired-while-holding
+    graph means two code paths can deadlock against each other."""
+    graph: dict[str, dict[str, tuple[str, int]]] = {}
+    for outer, inner, path, line in edges:
+        if outer == inner:
+            continue  # RLock re-entry / same-lock nesting is not an order
+        graph.setdefault(outer, {}).setdefault(inner, (path, line))
+    findings: list[Finding] = []
+    reported: set[frozenset] = set()
+    for a, nbrs in graph.items():
+        for b in nbrs:
+            if a in graph.get(b, {}):
+                key = frozenset((a, b))
+                if key in reported:
+                    continue
+                reported.add(key)
+                p1, l1 = graph[a][b]
+                p2, l2 = graph[b][a]
+                findings.append(Finding(
+                    "lock-order-inversion", p1, l1, 0,
+                    f"lock {a} is taken before {b} here, but {b} before "
+                    f"{a} at {p2}:{l2}"))
+    return findings

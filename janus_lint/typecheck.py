@@ -1,0 +1,67 @@
+"""Optional mypy pass, strict on the wire-format and crypto cores.
+
+``janus_tpu/messages/`` and ``janus_tpu/core/`` are the two packages
+whose bugs corrupt bytes on the wire or keys at rest, so they carry
+``mypy --strict``; the rest of the repo is dynamically typed by design
+(jit tracing, ctypes, optional deps).
+
+mypy is NOT a hard dependency: the runtime image may not ship it.  When
+the module is unavailable the pass reports itself skipped and the lint
+exit code is unaffected (CI installs mypy explicitly, so the gap cannot
+hide type rot from the gate).  Set ``JANUS_LINT_MYPY=0`` to skip
+explicitly.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+from janus_lint import Finding
+
+STRICT_TARGETS = ("janus_tpu/messages", "janus_tpu/core")
+
+_LINE_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+):(?:(?P<col>\d+):)?"
+                      r" error: (?P<msg>.*)$")
+
+
+def mypy_available() -> bool:
+    if os.environ.get("JANUS_LINT_MYPY", "1") == "0":
+        return False
+    try:
+        import mypy  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def run_mypy(repo_root: str) -> tuple[list[Finding], str]:
+    """-> (findings, status).  status is 'ok', 'skipped', or 'error'."""
+    if not mypy_available():
+        return [], "skipped"
+    targets = [os.path.join(repo_root, t) for t in STRICT_TARGETS]
+    cmd = [sys.executable, "-m", "mypy", "--strict",
+           "--no-error-summary", "--hide-error-context",
+           "--no-color-output",
+           # jax/numpy ship incomplete stubs in many environments; the
+           # strictness we want is on OUR annotations, not theirs
+           "--ignore-missing-imports",
+           "--follow-imports=silent",
+           *targets]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600, cwd=repo_root)
+    except (OSError, subprocess.TimeoutExpired):
+        return [], "error"
+    findings: list[Finding] = []
+    for line in proc.stdout.splitlines():
+        m = _LINE_RE.match(line.strip())
+        if m:
+            findings.append(Finding(
+                "mypy-strict", m.group("path"), int(m.group("line")),
+                int(m.group("col") or 0), m.group("msg")))
+    if proc.returncode not in (0, 1):
+        return findings, "error"
+    return findings, "ok"

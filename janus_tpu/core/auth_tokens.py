@@ -10,6 +10,8 @@ Comparison against stored tokens goes through AuthenticationTokenHash
 
 from __future__ import annotations
 
+from collections.abc import Mapping
+
 import base64
 import hashlib
 import hmac
@@ -31,7 +33,7 @@ class AuthenticationToken:
     token_type: str
     token: str
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.token_type not in (self.TYPE_BEARER, self.TYPE_DAP_AUTH):
             raise ValueError(f"unknown token type {self.token_type}")
         if self.token_type == self.TYPE_DAP_AUTH:
@@ -79,7 +81,7 @@ class AuthenticationTokenHash:
         )
 
 
-def extract_bearer_token(headers) -> str | None:
+def extract_bearer_token(headers: "Mapping[str, str]") -> str | None:
     """Pull a bearer token out of an Authorization header value mapping."""
     auth = headers.get("Authorization") or headers.get("authorization")
     if auth is None:

@@ -9,11 +9,14 @@ return a (possibly noised) share in the same representation.
 
 from __future__ import annotations
 
+from typing import Any
+
 
 class NoDifferentialPrivacy:
     """Pass-through strategy (reference dp.rs:38)."""
 
-    def add_noise_to_agg_share(self, vdaf, agg_share, num_measurements):
+    def add_noise_to_agg_share(self, vdaf: Any, agg_share: Any,
+                               num_measurements: int) -> Any:
         return agg_share
 
 
@@ -21,5 +24,6 @@ class DpStrategy:
     """Base for custom strategies; kept minimal so field-arithmetic noise
     mechanisms (discrete Gaussian / Laplace over the VDAF field) can plug in."""
 
-    def add_noise_to_agg_share(self, vdaf, agg_share, num_measurements):
+    def add_noise_to_agg_share(self, vdaf: Any, agg_share: Any,
+                               num_measurements: int) -> Any:
         raise NotImplementedError

@@ -17,6 +17,7 @@ import hashlib
 import hmac as hmac_mod
 import os
 from dataclasses import dataclass
+from typing import Any, Callable, Sequence
 
 try:
     from cryptography.hazmat.primitives.asymmetric import ec
@@ -77,13 +78,14 @@ _HASHES = {
 }
 
 
-def _hkdf_extract(hash_fn, salt: bytes, ikm: bytes) -> bytes:
+def _hkdf_extract(hash_fn: "Callable[..., Any]", salt: bytes, ikm: bytes) -> bytes:
     if not salt:
         salt = bytes(hash_fn().digest_size)
     return hmac_mod.new(salt, ikm, hash_fn).digest()
 
 
-def _hkdf_expand(hash_fn, prk: bytes, info: bytes, length: int) -> bytes:
+def _hkdf_expand(hash_fn: "Callable[..., Any]", prk: bytes, info: bytes,
+                 length: int) -> bytes:
     out = b""
     t = b""
     i = 1
@@ -94,13 +96,13 @@ def _hkdf_expand(hash_fn, prk: bytes, info: bytes, length: int) -> bytes:
     return out[:length]
 
 
-def _labeled_extract(hash_fn, suite_id: bytes, salt: bytes, label: bytes,
-                     ikm: bytes) -> bytes:
+def _labeled_extract(hash_fn: "Callable[..., Any]", suite_id: bytes, salt: bytes,
+                     label: bytes, ikm: bytes) -> bytes:
     return _hkdf_extract(hash_fn, salt, b"HPKE-v1" + suite_id + label + ikm)
 
 
-def _labeled_expand(hash_fn, suite_id: bytes, prk: bytes, label: bytes,
-                    info: bytes, length: int) -> bytes:
+def _labeled_expand(hash_fn: "Callable[..., Any]", suite_id: bytes, prk: bytes,
+                    label: bytes, info: bytes, length: int) -> bytes:
     return _hkdf_expand(
         hash_fn, prk,
         length.to_bytes(2, "big") + b"HPKE-v1" + suite_id + label + info, length
@@ -224,7 +226,8 @@ def is_hpke_config_supported(config: HpkeConfig) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _key_and_nonce(config: HpkeConfig, shared_secret: bytes, info: bytes):
+def _key_and_nonce(config: HpkeConfig, shared_secret: bytes,
+                   info: bytes) -> "tuple[Any, bytes]":
     hash_fn = _HASHES[config.kdf_id.code]
     suite_id = (b"HPKE" + config.kem_id.code.to_bytes(2, "big")
                 + config.kdf_id.code.to_bytes(2, "big")
@@ -315,7 +318,8 @@ def open_ciphertexts_batch(keypair: "HpkeKeypair", application_info: bytes,
         [ct.payload for ct in ciphertexts], aads, prefer_device, stats)
 
 
-def open_ciphertexts_grouped(lanes, application_info: bytes,
+def open_ciphertexts_grouped(lanes: "Sequence[tuple[HpkeKeypair, HpkeCiphertext, bytes]]",
+                             application_info: bytes,
                              prefer_device: bool | None = None,
                              stats: dict | None = None
                              ) -> list[bytes | None]:
@@ -484,7 +488,7 @@ _hybrid_pool = None
 _hybrid_pool_lock = __import__("threading").Lock()
 
 
-def _hybrid_executor():
+def _hybrid_executor() -> "Any":
     global _hybrid_pool
     with _hybrid_pool_lock:
         if _hybrid_pool is None:
@@ -520,7 +524,7 @@ def _open_batch_hybrid(keypair: "HpkeKeypair", application_info: bytes,
     k = min(n - 1, max(1, bucket_floor(int(n * frac_q))))
     config = keypair.config
 
-    def dev_part():
+    def dev_part() -> "tuple[Any, float]":
         t0 = _t.monotonic()
         res = _open_batch_device(keypair, application_info, encs[:k],
                                  payloads[:k], aads[:k])
@@ -593,6 +597,6 @@ class HpkeKeypair:
         )
 
 
-def generate_hpke_config_and_private_key(*args, **kwargs) -> HpkeKeypair:
+def generate_hpke_config_and_private_key(*args: Any, **kwargs: Any) -> HpkeKeypair:
     """Name-parity alias for the reference's hpke.rs:212."""
     return HpkeKeypair.generate(*args, **kwargs)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import random
 import time
 from dataclasses import dataclass
+from typing import Callable, Iterator
 
 
 def is_retryable_http_status(status: int) -> bool:
@@ -25,7 +26,7 @@ class Backoff:
     max_elapsed_time: float | None = 60.0
     jitter: float = 0.5  # +/- fraction
 
-    def intervals(self):
+    def intervals(self) -> Iterator[float]:
         elapsed = 0.0
         interval = self.initial_interval
         while self.max_elapsed_time is None or elapsed < self.max_elapsed_time:
@@ -45,7 +46,7 @@ class LimitedRetryer:
     def __init__(self, max_retries: int):
         self.max_retries = max_retries
 
-    def intervals(self):
+    def intervals(self) -> Iterator[float]:
         for _ in range(self.max_retries):
             yield 0.0
 
@@ -53,12 +54,13 @@ class LimitedRetryer:
 @dataclass
 class HttpResult:
     status: int
-    headers: dict
+    headers: dict[str, str]
     body: bytes
 
 
-def retry_http_request(request_fn, backoff: Backoff | LimitedRetryer | None = None,
-                       sleep=time.sleep):
+def retry_http_request(request_fn: Callable[[], HttpResult],
+                       backoff: Backoff | LimitedRetryer | None = None,
+                       sleep: Callable[[float], None] = time.sleep) -> HttpResult:
     """Run request_fn() -> HttpResult, retrying retryable failures.
 
     request_fn may raise OSError (connection failure) or return an HttpResult

@@ -312,13 +312,17 @@ class Datastore:
                 return result
             except SerializationConflict as e:
                 abort()
-                self.tx_retry_count += 1
+                # unlike the sqlite path there is no process-level tx lock
+                # here, so the counter increment needs one of its own
+                with self._tx_lock:
+                    self.tx_retry_count += 1
                 _metric_tx_retry(name)
                 last = e
             except db_errors as e:
                 if self.backend.is_serialization_failure(e):
                     abort()
-                    self.tx_retry_count += 1
+                    with self._tx_lock:
+                        self.tx_retry_count += 1
                     _metric_tx_retry(name)
                     last = SerializationConflict(str(e))
                 else:

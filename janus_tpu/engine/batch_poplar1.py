@@ -122,6 +122,9 @@ class BatchPoplar1(HostPrepEngine):
         # compile) costs more than the host loop; small service batches take
         # the oracle path
         self.device_min_batch = device_min_batch
+        import threading
+
+        self._stats_lock = threading.Lock()
 
     def bind(self, agg_param: bytes) -> "BatchPoplar1":
         return BatchPoplar1(self.vdaf.with_agg_param(agg_param),
@@ -296,7 +299,9 @@ class BatchPoplar1(HostPrepEngine):
         out: list = [None] * len(decoded)
         for k, i in enumerate(idx):
             if rej[k]:
-                self.fallback_count += 1
+                # racy += under concurrent job workers without the lock
+                with self._stats_lock:
+                    self.fallback_count += 1
                 continue  # host fallback (XOF rejection lane)
             state = PrepState([int(v) for v in ys_i[:, k]], None)
             state.poplar = (agg_id, level, int(abc_i[0, k]),

@@ -66,6 +66,14 @@ class CoalescingEngine:
         self._tune_defaults = (max_batch, max_delay_ms)
         self._last_retune = 0.0
         self._lock = threading.Lock()
+        # per-kind tuned operating point: {"helper"|"leader": (max_batch,
+        # delay_s)}.  Leader lanes carry the measurement+proof tensors and
+        # are several times wider than helper lanes, so one shared
+        # operating point sized from lane_upload_bytes("helper") would
+        # overfill the link budget ~Nx on a leader-heavy deployment.
+        # Guarded by _lock: written on the dispatcher thread, read by
+        # every submitter.
+        self._tuned: dict[str, tuple[int, float]] = {}
         self._queue: list[_Pending] = []
         self._dispatcher: threading.Thread | None = None
         # Launches run on a small pool so several can be in flight at once:
@@ -133,33 +141,56 @@ class CoalescingEngine:
 
     # -- machinery ---------------------------------------------------------
 
+    def _params(self, kind: str) -> tuple[int, float]:
+        """(max_batch, delay_s) for `kind`: the tuned per-kind operating
+        point when the link estimator has produced one, else the
+        constructor/attribute defaults."""
+        with self._lock:
+            tuned = self._tuned.get(kind)
+        if tuned is not None:
+            return tuned
+        return self.max_batch, self.max_delay
+
+    def _window_delay(self) -> float:
+        """Collection-window sleep for the dispatcher: the smallest delay
+        across kinds — a window short enough for the latency-tightest
+        kind never hurts the other (it just flushes more often)."""
+        with self._lock:
+            tuned = dict(self._tuned)
+        if not tuned:
+            return self.max_delay
+        return min(delay for _mb, delay in tuned.values())
+
     def _retune(self) -> None:
-        """Refresh max_batch/max_delay from the link estimate (at most
-        once a second — the EWMA moves slowly and the dispatch loop is
-        hot).  Runs on the dispatcher thread; max_batch/max_delay are
-        plain attribute writes racing only with reads, which is benign —
-        every interleaving is a valid operating point."""
+        """Refresh the per-kind operating points from the link estimate
+        (at most once a second — the EWMA moves slowly and the dispatch
+        loop is hot).  Runs on the dispatcher thread; recommendations are
+        computed outside the lock and installed under it."""
         if not self.adaptive:
             return
         now = time.monotonic()
-        if now - self._last_retune < 1.0:
-            return
-        self._last_retune = now
+        with self._lock:
+            if now - self._last_retune < 1.0:
+                return
+            self._last_retune = now
         lane_bytes = getattr(self.inner, "lane_upload_bytes", None)
         if lane_bytes is None:
             return
-        mb, delay_ms = streaming.recommend_coalesce_params(
-            streaming.LINK, lane_bytes("helper"),
-            default_max_batch=self._tune_defaults[0],
-            default_delay_ms=self._tune_defaults[1])
-        self.max_batch = mb
-        self.max_delay = delay_ms / 1000.0
+        tuned = {}
+        for kind in ("helper", "leader"):
+            mb, delay_ms = streaming.recommend_coalesce_params(
+                streaming.LINK, lane_bytes(kind),
+                default_max_batch=self._tune_defaults[0],
+                default_delay_ms=self._tune_defaults[1])
+            tuned[kind] = (mb, delay_ms / 1000.0)
+        with self._lock:
+            self._tuned = tuned
 
     def _submit(self, kind: str, verify_key, args) -> list[PreparedReport]:
         n = len(args[0])
         if n == 0:
             return []
-        if n >= self.max_batch or not self.inner.device_ok:
+        if n >= self._params(kind)[0] or not self.inner.device_ok:
             # big enough to own a launch (or host path): no coalescing
             fn = (self.inner.helper_init_batch if kind == "helper"
                   else self.inner.leader_init_batch)
@@ -181,20 +212,21 @@ class CoalescingEngine:
         try:
             while True:
                 self._retune()
-                time.sleep(self.max_delay)  # collection window
+                time.sleep(self._window_delay())  # collection window
                 with self._lock:
                     if not self._queue:
                         self._dispatcher = None
                         return
                     batch, self._queue = self._queue, []
-                # split by kind; pack each kind into launches of <=
-                # max_batch, submitted concurrently (bounded by the pool)
+                # split by kind; pack each kind into launches of <= its
+                # tuned max_batch, submitted concurrently (pool-bounded)
                 for kind in ("helper", "leader"):
                     group = [p for p in batch if p.kind == kind]
+                    kind_max = self._params(kind)[0]
                     chunk: list[_Pending] = []
                     total = 0
                     for p in group:
-                        if chunk and total + p.n > self.max_batch:
+                        if chunk and total + p.n > kind_max:
                             self._launch_pool.submit(self._run_group, kind,
                                                      chunk)
                             chunk, total = [], 0

@@ -81,7 +81,8 @@ class FlightRecorder:
 
     @property
     def capacity(self) -> int:
-        return self._events.maxlen or 0
+        with self._lock:
+            return self._events.maxlen or 0
 
     def clear(self) -> None:
         with self._lock:

@@ -18,7 +18,10 @@ from __future__ import annotations
 import enum
 import os
 from dataclasses import dataclass, field
-from typing import ClassVar
+from typing import Any, ClassVar, TypeVar
+
+_FB = TypeVar("_FB", bound="_FixedBytes")
+_UE = TypeVar("_UE", bound="_U16Enum")
 
 from janus_tpu.messages.codec import (
     Cursor,
@@ -86,8 +89,8 @@ class _FixedBytes(WireMessage):
     def __bytes__(self) -> bytes:
         return self._data
 
-    def __eq__(self, other) -> bool:
-        return type(self) is type(other) and self._data == other._data
+    def __eq__(self, other: object) -> bool:
+        return type(self) is type(other) and self._data == other._data  # type: ignore[attr-defined]
 
     def __hash__(self) -> int:
         return hash((type(self).__name__, self._data))
@@ -99,18 +102,18 @@ class _FixedBytes(WireMessage):
         return _b64url_encode(self._data)
 
     @classmethod
-    def from_str(cls, s: str):
+    def from_str(cls: type[_FB], s: str) -> _FB:
         return cls(_b64url_decode(s, cls.SIZE, cls.__name__))
 
     @classmethod
-    def random(cls):
+    def random(cls: type[_FB]) -> _FB:
         return cls(os.urandom(cls.SIZE))
 
     def encode(self) -> bytes:
         return self._data
 
     @classmethod
-    def decode_from(cls, cur: Cursor):
+    def decode_from(cls: type[_FB], cur: Cursor) -> _FB:
         return cls(cur.take(cls.SIZE))
 
 
@@ -229,7 +232,7 @@ class Interval(WireMessage):
     start: Time
     duration: Duration
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.start.seconds + self.duration.seconds >= 1 << 64:
             raise ValueError("interval overflow")
 
@@ -316,13 +319,13 @@ class _U16Enum:
             raise ValueError("code out of range")
         self.code = code
 
-    def __eq__(self, other):
-        return type(self) is type(other) and self.code == other.code
+    def __eq__(self, other: object) -> bool:
+        return type(self) is type(other) and self.code == other.code  # type: ignore[attr-defined]
 
-    def __hash__(self):
+    def __hash__(self) -> int:
         return hash((type(self).__name__, self.code))
 
-    def __repr__(self):
+    def __repr__(self) -> str:
         name = self.KNOWN.get(self.code, "Other")
         return f"{type(self).__name__}({name}:{self.code:#06x})"
 
@@ -334,7 +337,7 @@ class _U16Enum:
         return u16(self.code)
 
     @classmethod
-    def decode_from(cls, cur: Cursor):
+    def decode_from(cls: "type[_UE]", cur: Cursor) -> "_UE":
         return cls(cur.u16())
 
 
@@ -516,19 +519,19 @@ class QueryType:
     CODE: int
     NAME: str
 
-    def encode_identifier(self, ident) -> bytes:
+    def encode_identifier(self, ident: Any) -> bytes:
         raise NotImplementedError
 
-    def decode_identifier(self, cur: Cursor):
+    def decode_identifier(self, cur: Cursor) -> Any:
         raise NotImplementedError
 
-    def encode_partial_identifier(self, ident) -> bytes:
+    def encode_partial_identifier(self, ident: Any) -> bytes:
         raise NotImplementedError
 
-    def decode_partial_identifier(self, cur: Cursor):
+    def decode_partial_identifier(self, cur: Cursor) -> Any:
         raise NotImplementedError
 
-    def __repr__(self):
+    def __repr__(self) -> str:
         return self.NAME
 
 
@@ -543,10 +546,10 @@ class _TimeInterval(QueryType):
     def decode_identifier(self, cur: Cursor) -> Interval:
         return Interval.decode_from(cur)
 
-    def encode_partial_identifier(self, ident) -> bytes:
+    def encode_partial_identifier(self, ident: Any) -> bytes:
         return b""
 
-    def decode_partial_identifier(self, cur: Cursor):
+    def decode_partial_identifier(self, cur: Cursor) -> None:
         return None
 
 
@@ -664,7 +667,8 @@ class PartialBatchSelector(WireMessage):
         )
 
     @classmethod
-    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+    def decode_expecting(cls, cur: Cursor,
+                         expect: QueryType | None = None) -> "PartialBatchSelector":
         qt = _decode_query_type(cur, expect)
         return cls(qt, qt.decode_partial_identifier(cur))
 
@@ -695,7 +699,8 @@ class Collection(WireMessage):
                 + self.helper_encrypted_agg_share.encode())
 
     @classmethod
-    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+    def decode_expecting(cls, cur: Cursor,
+                         expect: QueryType | None = None) -> "Collection":
         return cls(
             PartialBatchSelector.decode_expecting(cur, expect),
             cur.u64(),
@@ -905,7 +910,8 @@ class AggregationJobInitializeReq(WireMessage):
                 + encode_vec32(self.prepare_inits))
 
     @classmethod
-    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+    def decode_expecting(cls, cur: Cursor,
+                         expect: QueryType | None = None) -> "AggregationJobInitializeReq":
         agg_param = cur.opaque32()
         pbs = PartialBatchSelector.decode_expecting(cur, expect)
         inits = cls._decode_inits_native(cur)
@@ -914,7 +920,7 @@ class AggregationJobInitializeReq(WireMessage):
         return cls(agg_param, pbs, inits)
 
     @classmethod
-    def _decode_inits_native(cls, cur: Cursor):
+    def _decode_inits_native(cls, cur: Cursor) -> "tuple[PrepareInit, ...] | None":
         """Fast path: one C++ pass over the PrepareInit vector emits an
         offset table (janus_tpu.native); falls back to the Python codec when
         the native library is unavailable."""
@@ -946,7 +952,8 @@ class AggregationJobInitializeReq(WireMessage):
     decode_from = decode_expecting
 
     @classmethod
-    def decode_columns(cls, data: bytes, expect: QueryType | None = None):
+    def decode_columns(cls, data: bytes, expect: QueryType | None = None,
+                       ) -> "tuple[bytes, PartialBatchSelector, bytes, Any] | None":
         """Columnar decode for the helper's hot path: ONE native pass over
         the PrepareInit vector, NO per-report message objects.  Returns
         (aggregation_parameter, partial_batch_selector, body, table) where
@@ -1006,7 +1013,7 @@ class AggregationJobContinueReq(WireMessage):
         return cls(step, continues)
 
     @classmethod
-    def _decode_continues_native(cls, cur: Cursor):
+    def _decode_continues_native(cls, cur: Cursor) -> "tuple[PrepareContinue, ...] | None":
         """Fast path: one C++ pass over the PrepareContinue vector
         (janus_tpu.native); None -> Python codec fallback."""
         from janus_tpu import native
@@ -1065,7 +1072,7 @@ class AggregationJobResp(WireMessage):
         return cls(resps)
 
     @classmethod
-    def _decode_native(cls, cur: Cursor):
+    def _decode_native(cls, cur: Cursor) -> "tuple[PrepareResp, ...] | None":
         """Fast path: one C++ pass over the PrepareResp vector
         (janus_tpu.native); None -> Python codec fallback."""
         from janus_tpu import native
@@ -1111,7 +1118,8 @@ class BatchSelector(WireMessage):
         )
 
     @classmethod
-    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+    def decode_expecting(cls, cur: Cursor,
+                         expect: QueryType | None = None) -> "BatchSelector":
         qt = _decode_query_type(cur, expect)
         return cls(qt, qt.decode_identifier(cur))
 
@@ -1140,7 +1148,8 @@ class AggregateShareReq(WireMessage):
                 + u64(self.report_count) + self.checksum.encode())
 
     @classmethod
-    def decode_expecting(cls, cur: Cursor, expect: QueryType | None = None):
+    def decode_expecting(cls, cur: Cursor,
+                         expect: QueryType | None = None) -> "AggregateShareReq":
         return cls(
             BatchSelector.decode_expecting(cur, expect),
             cur.opaque32(),

@@ -10,6 +10,15 @@ impls this is a tiny cursor/builder pair; message types compose it.
 from __future__ import annotations
 
 import struct
+from typing import Callable, Iterable, Protocol, TypeVar
+
+
+class _Encodable(Protocol):
+    def encode(self) -> bytes: ...
+
+
+_T = TypeVar("_T")
+_M = TypeVar("_M", bound="WireMessage")
 
 
 class DecodeError(ValueError):
@@ -97,29 +106,29 @@ def opaque32(data: bytes) -> bytes:
     return u32(len(data)) + data
 
 
-def encode_vec16(items) -> bytes:
+def encode_vec16(items: Iterable[_Encodable]) -> bytes:
     """u16-byte-length-prefixed concatenation of encoded items."""
     body = b"".join(item.encode() for item in items)
     return u16(len(body)) + body
 
 
-def encode_vec32(items) -> bytes:
+def encode_vec32(items: Iterable[_Encodable]) -> bytes:
     """u32-byte-length-prefixed concatenation of encoded items."""
     body = b"".join(item.encode() for item in items)
     return u32(len(body)) + body
 
 
-def decode_vec16(cur: Cursor, decode_one) -> list:
+def decode_vec16(cur: Cursor, decode_one: Callable[[Cursor], _T]) -> list[_T]:
     body = Cursor(cur.opaque16())
-    out = []
+    out: list[_T] = []
     while body.remaining():
         out.append(decode_one(body))
     return out
 
 
-def decode_vec32(cur: Cursor, decode_one) -> list:
+def decode_vec32(cur: Cursor, decode_one: Callable[[Cursor], _T]) -> list[_T]:
     body = Cursor(cur.opaque32())
-    out = []
+    out: list[_T] = []
     while body.remaining():
         out.append(decode_one(body))
     return out
@@ -132,11 +141,11 @@ class WireMessage:
         raise NotImplementedError
 
     @classmethod
-    def decode_from(cls, cur: Cursor):
+    def decode_from(cls: type[_M], cur: Cursor) -> _M:
         raise NotImplementedError
 
     @classmethod
-    def decode(cls, data: bytes):
+    def decode(cls: type[_M], data: bytes) -> _M:
         cur = Cursor(data)
         out = cls.decode_from(cur)
         cur.finish()

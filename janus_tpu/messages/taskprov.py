@@ -9,8 +9,12 @@ task id is the SHA-256 of those encoded bytes.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
 
 from janus_tpu.messages import Duration, Time
+
+if TYPE_CHECKING:
+    from janus_tpu.messages import TaskId
 from janus_tpu.messages.codec import (
     Cursor,
     DecodeError,
@@ -189,7 +193,7 @@ class VdafType(WireMessage):
             return cls(code, bits=cur.u16())
         raise DecodeError(f"unexpected VDAF type code value {code}")
 
-    def to_vdaf_instance(self):
+    def to_vdaf_instance(self) -> "Any":
         """-> models.VdafInstance (reference core/src/vdaf.rs TryFrom)."""
         from janus_tpu.models import VdafInstance
 
@@ -237,7 +241,7 @@ class TaskConfig(WireMessage):
     task_expiration: Time
     vdaf_config: VdafConfig
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not self.task_info:
             raise ValueError("task_info must not be empty")
 
@@ -262,7 +266,7 @@ class TaskConfig(WireMessage):
         return cls(task_info, leader, helper, query_config, expiration,
                    vdaf_config)
 
-    def task_id(self):
+    def task_id(self) -> "TaskId":
         """Taskprov task id: SHA-256 of the encoded config
         (reference http_handlers.rs:671)."""
         import hashlib

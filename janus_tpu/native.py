@@ -42,7 +42,13 @@ def _build(src_name: str, link_flags: tuple[str, ...] = ()) -> str | None:
             src = f.read()
     except OSError:
         return None
-    digest = hashlib.sha256(src).hexdigest()[:16]
+    # extra compile flags (native/Makefile's `sanitize` target injects
+    # -fsanitize=address,undefined here so the whole native test subset
+    # runs against instrumented builds); part of the cache key so
+    # sanitized and plain artifacts never collide
+    extra = tuple(os.environ.get("JANUS_TPU_NATIVE_CFLAGS", "").split())
+    digest = hashlib.sha256(
+        src + b"\x00" + " ".join(extra).encode()).hexdigest()[:16]
     cache_dir = os.environ.get(
         "JANUS_TPU_NATIVE_CACHE",
         os.path.expanduser("~/.cache/janus_tpu_native"))
@@ -53,9 +59,9 @@ def _build(src_name: str, link_flags: tuple[str, ...] = ()) -> str | None:
     tmp = out + f".tmp{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src_path,
+            ["g++", "-O2", "-shared", "-fPIC", *extra, "-o", tmp, src_path,
              *link_flags],
-            check=True, capture_output=True, timeout=120)
+            check=True, capture_output=True, timeout=300)
         os.replace(tmp, out)
         return out
     except Exception:

@@ -161,5 +161,6 @@ def aes128_gcm_open(key, nonce, aad, ct):
         [(s[..., i // 4] >> _U32(24 - 8 * (i % 4))).astype(_U8)
          for i in range(16)], axis=-1)  # [N, 16]
     tag = ej0 ^ s_bytes
+    # janus-lint: disable=nonconstant-compare -- vectorized device compare over all 16 tag bytes of every lane; no data-dependent short circuit
     ok = jnp.all(tag == ct[:, pt_len:], axis=-1)
     return pt, ok

@@ -20,6 +20,7 @@ joint rand part 7.
 
 from __future__ import annotations
 
+import hmac
 from dataclasses import dataclass
 
 from janus_tpu.vdaf.flp import Flp, FlpError
@@ -264,7 +265,11 @@ class Prio3:
         if self.has_joint_rand:
             if msg.joint_rand_seed is None or state.joint_rand_seed is None:
                 raise VdafError("missing joint rand seed")
-            if msg.joint_rand_seed != state.joint_rand_seed:
+            # constant-time: the peer-supplied seed is compared against
+            # secret-derived material, so byte-wise short-circuit equality
+            # would be a timing oracle
+            if not hmac.compare_digest(msg.joint_rand_seed,
+                                       state.joint_rand_seed):
                 raise VdafError("joint randomness check failed")
         return state.out_share
 

@@ -51,7 +51,7 @@ long parse_prepare_inits(const uint8_t* buf, long len, long max_reports,
         if (off + 4 > len) return -1;
         uint32_t pub_len = rd32(buf + off);
         off += 4;
-        if (off + pub_len > (uint64_t)len) return -1;
+        if ((uint64_t)off + pub_len > (uint64_t)len) return -1;
         row[2] = off;
         row[3] = pub_len;
         off += pub_len;
@@ -67,14 +67,14 @@ long parse_prepare_inits(const uint8_t* buf, long len, long max_reports,
         off += enc_len;
         uint32_t ct_len = rd32(buf + off);
         off += 4;
-        if (off + ct_len + 4 > (uint64_t)len) return -1;
+        if ((uint64_t)off + ct_len + 4 > (uint64_t)len) return -1;
         row[7] = off;
         row[8] = ct_len;
         off += ct_len;
         // ping-pong message
         uint32_t msg_len = rd32(buf + off);
         off += 4;
-        if (off + msg_len > (uint64_t)len) return -1;
+        if ((uint64_t)off + msg_len > (uint64_t)len) return -1;
         row[9] = off;
         row[10] = msg_len;
         off += msg_len;
@@ -98,7 +98,7 @@ long parse_prepare_continues(const uint8_t* buf, long len, long max_reports,
         off += 16;
         uint32_t msg_len = rd32(buf + off);
         off += 4;
-        if (off + msg_len > (uint64_t)len) return -1;
+        if ((uint64_t)off + msg_len > (uint64_t)len) return -1;
         row[1] = off;
         row[2] = msg_len;
         off += msg_len;
@@ -198,7 +198,7 @@ long parse_prepare_resps(const uint8_t* buf, long len, long max_reports,
             if (off + 4 > len) return -1;
             uint32_t msg_len = rd32(buf + off);
             off += 4;
-            if (off + msg_len > (uint64_t)len) return -1;
+            if ((uint64_t)off + msg_len > (uint64_t)len) return -1;
             row[2] = off;
             row[3] = msg_len;
             off += msg_len;

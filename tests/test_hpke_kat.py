@@ -7,6 +7,7 @@ key schedule fails here independently of our own seal/open roundtrips.
 """
 
 import json
+import os
 
 import pytest
 
@@ -22,6 +23,10 @@ from janus_tpu.messages import (
 )
 
 VECTORS_PATH = "/root/reference/core/src/test-vectors.json"
+
+if not os.path.exists(VECTORS_PATH):
+    pytest.skip(f"CFRG vectors not present ({VECTORS_PATH})",
+                allow_module_level=True)
 
 
 def _load_vectors():
