@@ -15,6 +15,10 @@ Process-based: it spawns the five services itself (the same commands the
 containers run) and drives them over HTTP; for the docker topology,
 provision tasks via `docker compose exec` + tools, then drive the ports.
 
+The topology lives in ``ComposedTopology`` so other harnesses reuse it —
+the soak driver (soak.py --mode compose) provisions a mixed-VDAF task
+matrix on the same five processes and scrapes their health listeners.
+
 Usage:
     python deploy/compose_e2e.py            # self-contained process pair
 """
@@ -23,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import base64
+import json
 import os
 import secrets
 import signal
@@ -32,6 +37,7 @@ import sys
 import tempfile
 import time
 import urllib.request
+from dataclasses import dataclass, field
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -67,6 +73,231 @@ def wait_health(port: int, timeout: float = 60.0) -> None:
     raise TimeoutError(f"health check on :{port} never came up")
 
 
+@dataclass
+class TaskSpec:
+    """One task to provision into both aggregators.  ``vdaf`` is the
+    JSON shape VdafInstance.from_json_obj accepts ("Prio3Count" or
+    {"Prio3Sum": {"bits": 8}})."""
+
+    vdaf: object = "Prio3Count"
+    min_batch_size: int = 1
+    time_precision_s: int = 3600
+    tolerable_clock_skew_s: int = 600
+    report_expiry_age_s: int | None = None
+    task_id: bytes = field(default_factory=lambda: secrets.token_bytes(32))
+    verify_key: bytes = field(default_factory=lambda: secrets.token_bytes(16))
+
+    def yaml_fragment(self, role: str, peer: str, agg_token: str,
+                     col_token: str, collector_config_b64: str) -> str:
+        lines = [
+            f"- task_id: {b64(self.task_id)}",
+            f"  role: {role}",
+            f"  peer_aggregator_endpoint: {peer}",
+            "  query_type: TimeInterval",
+            f"  vdaf: {json.dumps(self.vdaf)}",  # JSON is valid YAML
+            f"  vdaf_verify_key: {b64(self.verify_key)}",
+            f"  min_batch_size: {self.min_batch_size}",
+            f"  time_precision: {self.time_precision_s}",
+            f"  tolerable_clock_skew: {self.tolerable_clock_skew_s}",
+        ]
+        if self.report_expiry_age_s is not None:
+            lines.append(f"  report_expiry_age: {self.report_expiry_age_s}")
+        lines += [
+            f"  collector_hpke_config: {collector_config_b64}",
+            "  aggregator_auth_token:",
+            "    type: Bearer",
+            f"    token: {agg_token}",
+        ]
+        if role == "Leader":
+            lines += [
+                "  collector_auth_token:",
+                "    type: Bearer",
+                f"    token: {col_token}",
+            ]
+        return "\n".join(lines) + "\n"
+
+
+class ComposedTopology:
+    """The five composed services as local subprocesses — the same
+    commands the docker-compose containers run.
+
+    Lifecycle: construct, ``provision(task_specs)``, ``start()``, drive
+    over HTTP (``leader_url``/``helper_url``; per-service health +
+    debug listeners at ``health_services``), ``stop()``.
+    """
+
+    SERVICE_NAMES = ("helper_aggregator", "leader_aggregator",
+                     "aggregation_job_creator", "aggregation_job_driver",
+                     "collection_job_driver")
+
+    def __init__(self, leader_db: str | None = None,
+                 helper_db: str | None = None,
+                 job_discovery_interval_s: float = 1,
+                 min_aggregation_job_size: int = 1,
+                 max_aggregation_job_size: int = 100,
+                 shard_count: int = 4,
+                 debug_console: bool = False):
+        from janus_tpu.core.auth_tokens import AuthenticationToken
+        from janus_tpu.core.hpke import HpkeKeypair
+
+        self.tmp = tempfile.mkdtemp(prefix="janus_compose_")
+        self.leader_db = leader_db or os.path.join(self.tmp, "leader.db")
+        self.helper_db = helper_db or os.path.join(self.tmp, "helper.db")
+        self.leader_port, self.helper_port = free_port(), free_port()
+        self.health_ports = [free_port() for _ in range(5)]
+        self.keys = {self.leader_db: b64(secrets.token_bytes(16)),
+                     self.helper_db: b64(secrets.token_bytes(16))}
+        self.agg_token = AuthenticationToken(
+            "Bearer", b64(secrets.token_bytes(16)))
+        self.col_token = AuthenticationToken(
+            "Bearer", b64(secrets.token_bytes(16)))
+        self.collector_kp = HpkeKeypair.generate(7)
+        self.job_discovery_interval_s = job_discovery_interval_s
+        self.min_aggregation_job_size = min_aggregation_job_size
+        self.max_aggregation_job_size = max_aggregation_job_size
+        self.shard_count = shard_count
+        self.debug_console = debug_console
+        self.task_specs: list[TaskSpec] = []
+        self.procs: list[subprocess.Popen] = []
+        self.logs: list[str] = []
+
+    # -- provisioning ------------------------------------------------------
+
+    def _tools(self, *argv):
+        subprocess.run(
+            [sys.executable, "-m", "janus_tpu.tools", *argv],
+            check=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO})
+
+    def provision(self, task_specs: list) -> None:
+        for db in (self.leader_db, self.helper_db):
+            if db.startswith(("postgres://", "postgresql://")):
+                # persistent server: reset so reruns are repeatable (fresh
+                # datastore keys cannot decrypt a previous run's rows)
+                self._tools("write-schema", "--db", db, "--drop")
+            else:
+                self._tools("write-schema", "--db", db)
+        self.task_specs = list(task_specs)
+        col_cfg = b64(self.collector_kp.config.encode())
+        leader_yaml = "".join(spec.yaml_fragment(
+            "Leader", f"http://127.0.0.1:{self.helper_port}/",
+            self.agg_token.token, self.col_token.token, col_cfg)
+            for spec in self.task_specs)
+        helper_yaml = "".join(spec.yaml_fragment(
+            "Helper", f"http://127.0.0.1:{self.leader_port}/",
+            self.agg_token.token, self.col_token.token, col_cfg)
+            for spec in self.task_specs)
+        leader_tasks = write_yaml(
+            os.path.join(self.tmp, "tasks_leader.yaml"), leader_yaml)
+        helper_tasks = write_yaml(
+            os.path.join(self.tmp, "tasks_helper.yaml"), helper_yaml)
+        # `=` form: a random urlsafe-b64 key may begin with '-'
+        self._tools("provision-tasks", "--db", self.leader_db,
+                    f"--datastore-keys={self.keys[self.leader_db]}",
+                    leader_tasks)
+        self._tools("provision-tasks", "--db", self.helper_db,
+                    f"--datastore-keys={self.keys[self.helper_db]}",
+                    helper_tasks)
+
+    # -- the five composed services, same commands as the containers ------
+
+    def _service_configs(self) -> list:
+        health = self.health_ports
+
+        def cfg_common(db, hp):
+            return (f"common:\n  database:\n    url: {db}\n"
+                    f"  health_check_listen_address: 127.0.0.1:{hp}\n")
+
+        return [
+            ("aggregator", write_yaml(
+                os.path.join(self.tmp, "helper_agg.yaml"),
+                cfg_common(self.helper_db, health[0]) +
+                f"listen_address: 127.0.0.1:{self.helper_port}\n"
+                f"batch_aggregation_shard_count: {self.shard_count}\n"),
+             self.helper_db),
+            ("aggregator", write_yaml(
+                os.path.join(self.tmp, "leader_agg.yaml"),
+                cfg_common(self.leader_db, health[1]) +
+                f"listen_address: 127.0.0.1:{self.leader_port}\n"
+                f"batch_aggregation_shard_count: {self.shard_count}\n"),
+             self.leader_db),
+            ("aggregation_job_creator", write_yaml(
+                os.path.join(self.tmp, "creator.yaml"),
+                cfg_common(self.leader_db, health[2]) +
+                "tasks_update_frequency_s: 2\n"
+                "aggregation_job_creation_interval_s: 1\n"
+                f"min_aggregation_job_size: {self.min_aggregation_job_size}\n"
+                f"max_aggregation_job_size: {self.max_aggregation_job_size}\n"
+                f"batch_aggregation_shard_count: {self.shard_count}\n"),
+             self.leader_db),
+            ("aggregation_job_driver", write_yaml(
+                os.path.join(self.tmp, "agg_driver.yaml"),
+                cfg_common(self.leader_db, health[3]) +
+                "job_driver:\n"
+                f"  job_discovery_interval_s: {self.job_discovery_interval_s}\n"
+                f"batch_aggregation_shard_count: {self.shard_count}\n"),
+             self.leader_db),
+            ("collection_job_driver", write_yaml(
+                os.path.join(self.tmp, "coll_driver.yaml"),
+                cfg_common(self.leader_db, health[4]) +
+                "job_driver:\n"
+                f"  job_discovery_interval_s: {self.job_discovery_interval_s}\n"
+                f"batch_aggregation_shard_count: {self.shard_count}\n"),
+             self.leader_db),
+        ]
+
+    def start(self, health_timeout: float = 60.0) -> "ComposedTopology":
+        extra_env = {}
+        if self.debug_console:
+            extra_env["JANUS_DEBUG_CONSOLE"] = "1"
+        for i, (service, cfg, db) in enumerate(self._service_configs()):
+            log_path = os.path.join(self.tmp, f"{i}_{service}.log")
+            self.logs.append(log_path)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "janus_tpu.binaries", service,
+                 "--config-file", cfg],
+                cwd=REPO, stdout=open(log_path, "w"),
+                stderr=subprocess.STDOUT,
+                env={**os.environ, "PYTHONPATH": REPO,
+                     "JANUS_DATASTORE_KEYS": self.keys[db], **extra_env}))
+        for hp in self.health_ports:
+            wait_health(hp, timeout=health_timeout)
+        return self
+
+    def stop(self) -> None:
+        for p in self.procs:
+            p.send_signal(signal.SIGTERM)
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs = []
+
+    # -- addresses ---------------------------------------------------------
+
+    @property
+    def leader_url(self) -> str:
+        return f"http://127.0.0.1:{self.leader_port}"
+
+    @property
+    def helper_url(self) -> str:
+        return f"http://127.0.0.1:{self.helper_port}"
+
+    @property
+    def health_services(self) -> list:
+        return [(name, f"http://127.0.0.1:{port}")
+                for name, port in zip(self.SERVICE_NAMES, self.health_ports)]
+
+    def dump_logs(self, stream=sys.stderr, tail: int = 2000) -> None:
+        for lp in self.logs:
+            try:
+                with open(lp) as f:
+                    stream.write(f"===== {lp} =====\n{f.read()[-tail:]}\n")
+            except OSError:
+                continue
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--timeout", type=float, default=120.0)
@@ -78,118 +309,12 @@ def main() -> int:
                     help="datastore URL for the helper (see --leader-db)")
     args = ap.parse_args()
 
-    from janus_tpu.core.auth_tokens import AuthenticationToken
-    from janus_tpu.core.hpke import HpkeKeypair
-
-    tmp = tempfile.mkdtemp(prefix="janus_e2e_")
-    task_id = secrets.token_bytes(32)
-    verify_key = secrets.token_bytes(16)
-    agg_token = AuthenticationToken("Bearer", b64(secrets.token_bytes(16)))
-    col_token = AuthenticationToken("Bearer", b64(secrets.token_bytes(16)))
-    collector_kp = HpkeKeypair.generate(7)
-
-    leader_db = args.leader_db or os.path.join(tmp, "leader.db")
-    helper_db = args.helper_db or os.path.join(tmp, "helper.db")
-    leader_port, helper_port = free_port(), free_port()
-    health = [free_port() for _ in range(5)]
-    keys = {leader_db: b64(secrets.token_bytes(16)),
-            helper_db: b64(secrets.token_bytes(16))}
-
-    def tools(*argv, db):
-        subprocess.run(
-            [sys.executable, "-m", "janus_tpu.tools", *argv],
-            check=True, cwd=REPO,
-            env={**os.environ, "PYTHONPATH": REPO})
-
-    # -- provision both sides (reference janus_cli provision-tasks) -------
-    for db in (leader_db, helper_db):
-        if db.startswith(("postgres://", "postgresql://")):
-            # persistent server: reset so reruns are repeatable (fresh
-            # datastore keys cannot decrypt a previous run's rows)
-            tools("write-schema", "--db", db, "--drop", db=db)
-        else:
-            tools("write-schema", "--db", db, db=db)
-    common = f"""  query_type: TimeInterval
-  vdaf: Prio3Count
-  vdaf_verify_key: {b64(verify_key)}
-  min_batch_size: {len(MEASUREMENTS)}
-  time_precision: 3600
-  tolerable_clock_skew: 600
-  collector_hpke_config: {b64(collector_kp.config.encode())}
-"""
-    leader_tasks = write_yaml(os.path.join(tmp, "tasks_leader.yaml"), f"""
-- task_id: {b64(task_id)}
-  role: Leader
-  peer_aggregator_endpoint: http://127.0.0.1:{helper_port}/
-{common}  aggregator_auth_token:
-    type: Bearer
-    token: {agg_token.token}
-  collector_auth_token:
-    type: Bearer
-    token: {col_token.token}
-""")
-    helper_tasks = write_yaml(os.path.join(tmp, "tasks_helper.yaml"), f"""
-- task_id: {b64(task_id)}
-  role: Helper
-  peer_aggregator_endpoint: http://127.0.0.1:{leader_port}/
-{common}  aggregator_auth_token:
-    type: Bearer
-    token: {agg_token.token}
-""")
-    # `=` form: a random urlsafe-b64 key may begin with '-'
-    tools("provision-tasks", "--db", leader_db,
-          f"--datastore-keys={keys[leader_db]}", leader_tasks, db=leader_db)
-    tools("provision-tasks", "--db", helper_db,
-          f"--datastore-keys={keys[helper_db]}", helper_tasks, db=helper_db)
-
-    # -- the five composed services, same commands as the containers ------
-    def cfg_common(db, hp):
-        return (f"common:\n  database:\n    url: {db}\n"
-                f"  health_check_listen_address: 127.0.0.1:{hp}\n")
-
-    services = [
-        ("aggregator", write_yaml(os.path.join(tmp, "helper_agg.yaml"),
-            cfg_common(helper_db, health[0]) +
-            f"listen_address: 127.0.0.1:{helper_port}\n"
-            "batch_aggregation_shard_count: 4\n"), helper_db),
-        ("aggregator", write_yaml(os.path.join(tmp, "leader_agg.yaml"),
-            cfg_common(leader_db, health[1]) +
-            f"listen_address: 127.0.0.1:{leader_port}\n"
-            "batch_aggregation_shard_count: 4\n"), leader_db),
-        ("aggregation_job_creator",
-         write_yaml(os.path.join(tmp, "creator.yaml"),
-            cfg_common(leader_db, health[2]) +
-            "tasks_update_frequency_s: 2\n"
-            "aggregation_job_creation_interval_s: 1\n"
-            "min_aggregation_job_size: 1\n"
-            "max_aggregation_job_size: 100\n"
-            "batch_aggregation_shard_count: 4\n"), leader_db),
-        ("aggregation_job_driver",
-         write_yaml(os.path.join(tmp, "agg_driver.yaml"),
-            cfg_common(leader_db, health[3]) +
-            "job_driver:\n  job_discovery_interval_s: 1\n"
-            "batch_aggregation_shard_count: 4\n"), leader_db),
-        ("collection_job_driver",
-         write_yaml(os.path.join(tmp, "coll_driver.yaml"),
-            cfg_common(leader_db, health[4]) +
-            "job_driver:\n  job_discovery_interval_s: 1\n"
-            "batch_aggregation_shard_count: 4\n"), leader_db),
-    ]
-    procs: list[subprocess.Popen] = []
-    logs: list[str] = []
+    topo = ComposedTopology(leader_db=args.leader_db,
+                            helper_db=args.helper_db)
+    spec = TaskSpec(vdaf="Prio3Count", min_batch_size=len(MEASUREMENTS))
+    topo.provision([spec])
     try:
-        for i, (service, cfg, db) in enumerate(services):
-            log_path = os.path.join(tmp, f"{i}_{service}.log")
-            logs.append(log_path)
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "janus_tpu.binaries", service,
-                 "--config-file", cfg],
-                cwd=REPO, stdout=open(log_path, "w"),
-                stderr=subprocess.STDOUT,
-                env={**os.environ, "PYTHONPATH": REPO,
-                     "JANUS_DATASTORE_KEYS": keys[db]}))
-        for hp in health:
-            wait_health(hp)
+        topo.start()
 
         # -- client uploads + collection ----------------------------------
         from janus_tpu.client import Client, ClientParameters
@@ -199,11 +324,10 @@ def main() -> int:
         )
         from janus_tpu.models import VdafInstance
 
-        leader_url = f"http://127.0.0.1:{leader_port}"
-        helper_url = f"http://127.0.0.1:{helper_port}"
         inst = VdafInstance.prio3_count()
-        client = Client(ClientParameters(TaskId(task_id), leader_url,
-                                         helper_url, Duration(3600)), inst)
+        client = Client(ClientParameters(TaskId(spec.task_id),
+                                         topo.leader_url, topo.helper_url,
+                                         Duration(3600)), inst)
         for meas in MEASUREMENTS:
             client.upload(meas)
         # Let the leader's ReportWriteBatcher flush (max_batch_write_delay)
@@ -215,8 +339,8 @@ def main() -> int:
         start = now - (now % 3600)
         query = Query.time_interval(
             Interval(Time(start), Duration(7200)))
-        collector = Collector(TaskId(task_id), leader_url, col_token,
-                              collector_kp, inst)
+        collector = Collector(TaskId(spec.task_id), topo.leader_url,
+                              topo.col_token, topo.collector_kp, inst)
         job_id = collector.start_collection(query)
         deadline = time.time() + args.timeout
         result = None
@@ -226,26 +350,17 @@ def main() -> int:
                 break
             time.sleep(1.0)
         if result is None:
-            for lp in logs:
-                with open(lp) as f:
-                    tail = f.read()[-2000:]
-                print(f"===== {lp} =====\n{tail}", file=sys.stderr)
+            topo.dump_logs()
         assert result is not None, "collection never completed"
         assert result.report_count == len(MEASUREMENTS), result
         assert result.aggregate_result == sum(MEASUREMENTS), result
-        backend = ("postgres" if str(leader_db).startswith(
+        backend = ("postgres" if str(topo.leader_db).startswith(
             ("postgres://", "postgresql://")) else "sqlite")
         print(f"compose_e2e OK: {result.report_count} reports, "
               f"aggregate={result.aggregate_result}, backend={backend}")
         return 0
     finally:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        topo.stop()
 
 
 if __name__ == "__main__":

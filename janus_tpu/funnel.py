@@ -34,6 +34,9 @@ plane down — ``count``/``reject`` swallow their own failures.
 
 from __future__ import annotations
 
+import os
+import threading
+
 from janus_tpu import metrics
 
 # Lifecycle stages in pipeline order.  Loss deltas are computed between
@@ -41,15 +44,49 @@ from janus_tpu import metrics
 STAGES = ("uploaded", "validated", "stored", "agg_init", "prepare_done",
           "collected")
 
+# Rejections tallied INSIDE the store transaction (report_writer.py) hit
+# reports that were already counted ``validated``; every other reason
+# rejects between ``uploaded`` and ``validated``.  The conservation
+# audit needs the split: uploaded == validated + pre-store rejects, and
+# validated == stored + in-store rejects (+ in-flight buffer).
+IN_STORE_REJECTS = ("duplicate", "interval_collected")
+
+# Label-cardinality guard: one task contributes up to ~a dozen series per
+# role, so an unbounded task matrix (a million-task soak) would bloat
+# every /metrics scrape and break downstream aggregation
+# (metrics.lint_instruments flags runaway label sets).  The first
+# JANUS_FUNNEL_MAX_TASKS distinct tasks keep their own ledgers; overflow
+# tasks share the ``other`` bucket — still conserved, just not
+# attributable per task.
+OTHER_TASKS_LABEL = "other"
+
 reports_total = metrics.REGISTRY.counter(
     "janus_funnel_reports_total",
     "report-lifecycle funnel: reports per task/role reaching each stage "
     "(uploaded/validated/stored/agg_init/prepare_done/collected or a "
     "rejected_<reason> bucket)")
 
+_admitted: set = set()
+_admitted_lock = threading.Lock()
+
+
+def max_tasks() -> int:
+    """Per-task series cap (JANUS_FUNNEL_MAX_TASKS, default 64)."""
+    try:
+        return int(os.environ["JANUS_FUNNEL_MAX_TASKS"])
+    except (KeyError, ValueError):
+        return 64
+
 
 def _task_label(task_id) -> str:
-    return str(task_id)
+    label = str(task_id)
+    with _admitted_lock:
+        if label in _admitted:
+            return label
+        if len(_admitted) < max_tasks():
+            _admitted.add(label)
+            return label
+    return OTHER_TASKS_LABEL
 
 
 def count(stage: str, task_id, n: int = 1, role: str = "leader") -> None:
@@ -109,6 +146,172 @@ def snapshot() -> dict:
 
 
 def clear() -> None:
-    """Reset the funnel ledger (tests, bench)."""
-    with reports_total._lock:
-        reports_total._values.clear()
+    """Reset the funnel ledger and the task-admission set (tests, bench,
+    soak harness)."""
+    reports_total.reset()
+    with _admitted_lock:
+        _admitted.clear()
+
+
+# -- cross-task aggregation + conservation audit ---------------------------
+
+
+def merge_snapshots(snapshots) -> dict:
+    """Join per-process funnel views (the ``tasks`` payload each service
+    serves at /debug/funnel) into one cross-service ledger.
+
+    In the multi-process topology the leader's stages land in different
+    processes — uploaded/validated/stored in the leader aggregator,
+    agg_init/prepare_done in the aggregation job driver, collected in the
+    collection job driver — so conservation can only be judged on the
+    join.  Stage and rejection counts sum; loss deltas are recomputed.
+    """
+    merged: dict = {}
+    for snap in snapshots:
+        for task, roles in (snap or {}).items():
+            for role, ledger in roles.items():
+                out = merged.setdefault(task, {}).setdefault(
+                    role, {"stages": {}, "rejected": {}})
+                for stage, n in ledger.get("stages", {}).items():
+                    out["stages"][stage] = out["stages"].get(stage, 0) + n
+                for reason, n in ledger.get("rejected", {}).items():
+                    out["rejected"][reason] = (out["rejected"].get(reason, 0)
+                                               + n)
+    for roles in merged.values():
+        for ledger in roles.values():
+            stages, loss, prev = ledger["stages"], {}, None
+            for stage in STAGES:
+                if stage not in stages:
+                    continue
+                if prev is not None:
+                    loss[stage] = max(stages[prev] - stages[stage], 0)
+                prev = stage
+            ledger["loss"] = loss
+            ledger["rejected_total"] = sum(ledger["rejected"].values())
+    return merged
+
+
+def aggregate(tasks: dict | None = None) -> dict:
+    """Cross-task totals per role — the view an operator would otherwise
+    assemble by summing per-task ledgers by hand (/debug/funnel,
+    /debug/slo)."""
+    if tasks is None:
+        tasks = snapshot()
+    roles: dict = {}
+    for task_roles in tasks.values():
+        for role, ledger in task_roles.items():
+            out = roles.setdefault(role, {"stages": {}, "rejected": {}})
+            for stage, n in ledger.get("stages", {}).items():
+                out["stages"][stage] = out["stages"].get(stage, 0) + n
+            for reason, n in ledger.get("rejected", {}).items():
+                out["rejected"][reason] = out["rejected"].get(reason, 0) + n
+    for out in roles.values():
+        out["rejected_total"] = sum(out["rejected"].values())
+    return {"tasks": len(tasks), "roles": roles}
+
+
+def _check_ledger(task: str, role: str, ledger: dict, final: bool,
+                  violations: list, anomalies: list) -> dict:
+    stages = ledger.get("stages", {})
+    rejected = ledger.get("rejected", {})
+    where = f"task {task} role {role}"
+    pre_store_rejects = sum(n for r, n in rejected.items()
+                            if r not in IN_STORE_REJECTS)
+    in_store_rejects = sum(rejected.get(r, 0) for r in IN_STORE_REJECTS)
+    detail = {}
+
+    if "uploaded" in stages or "validated" in stages:
+        pending_validation = (stages.get("uploaded", 0)
+                              - stages.get("validated", 0)
+                              - pre_store_rejects)
+        detail["pending_validation"] = pending_validation
+        if pending_validation < 0:
+            violations.append(
+                f"{where}: validated+rejected exceeds uploaded by "
+                f"{-pending_validation}")
+        elif final and pending_validation:
+            violations.append(
+                f"{where}: {pending_validation} uploaded report(s) neither "
+                "validated nor rejected")
+    if "stored" in stages or "validated" in stages:
+        pending_store = (stages.get("validated", 0) - stages.get("stored", 0)
+                         - in_store_rejects)
+        detail["pending_store"] = pending_store
+        if pending_store < 0:
+            violations.append(
+                f"{where}: stored+in-store rejects exceeds validated by "
+                f"{-pending_store}")
+        elif final and pending_store:
+            violations.append(
+                f"{where}: {pending_store} validated report(s) never stored "
+                "(write buffer lost?)")
+    if role == "leader" and ("stored" in stages or "agg_init" in stages):
+        pending_agg = stages.get("stored", 0) - stages.get("agg_init", 0)
+        detail["pending_aggregation"] = pending_agg
+        if pending_agg < 0:
+            # lease-expiry retries legitimately re-count agg_init, so an
+            # excess is an anomaly to investigate, not lost reports
+            anomalies.append(
+                f"{where}: agg_init exceeds stored by {-pending_agg} "
+                "(job retries?)")
+        elif final and pending_agg:
+            violations.append(
+                f"{where}: {pending_agg} stored report(s) never entered "
+                "aggregation")
+    if "agg_init" in stages or "prepare_done" in stages:
+        prepare_loss = (stages.get("agg_init", 0)
+                        - stages.get("prepare_done", 0))
+        detail["prepare_loss"] = prepare_loss
+        if prepare_loss < 0:
+            anomalies.append(
+                f"{where}: prepare_done exceeds agg_init by {-prepare_loss} "
+                "(job retries?)")
+        elif final and prepare_loss:
+            violations.append(
+                f"{where}: {prepare_loss} report(s) entered aggregation but "
+                "never finished preparation")
+    if "collected" in stages:
+        pending_collect = (stages.get("prepare_done", 0)
+                           - stages.get("collected", 0))
+        detail["pending_collection"] = pending_collect
+        if pending_collect < 0:
+            anomalies.append(
+                f"{where}: collected exceeds prepare_done by "
+                f"{-pending_collect}")
+    return detail
+
+
+def conservation(tasks: dict | None = None, final: bool = False) -> dict:
+    """Funnel-conservation audit over a (possibly merged) per-task view:
+    every uploaded report must be accounted for.
+
+    Always enforced: no stage may exceed its upstream explanation
+    (``validated + rejected_* <= uploaded``, ``stored + in-store rejects
+    <= validated``) — a negative residual means phantom reports.  With
+    ``final=True`` (post-drain, end of a soak run) residuals must be
+    exactly zero and the leader/helper ledgers must agree on
+    ``agg_init``/``prepare_done``; mid-run, positive residuals are
+    in-flight work and are reported but tolerated.  Returns
+    ``{"ok", "final", "violations", "anomalies", "per_task"}``.
+    """
+    if tasks is None:
+        tasks = snapshot()
+    violations: list = []
+    anomalies: list = []
+    per_task: dict = {}
+    for task, roles in sorted(tasks.items()):
+        task_detail: dict = {}
+        for role, ledger in sorted(roles.items()):
+            task_detail[role] = _check_ledger(task, role, ledger, final,
+                                              violations, anomalies)
+        if final and "leader" in roles and "helper" in roles:
+            for stage in ("agg_init", "prepare_done"):
+                lv = roles["leader"].get("stages", {}).get(stage, 0)
+                hv = roles["helper"].get("stages", {}).get(stage, 0)
+                if lv != hv:
+                    violations.append(
+                        f"task {task}: leader/helper disagree on {stage} "
+                        f"({lv} vs {hv})")
+        per_task[task] = task_detail
+    return {"ok": not violations, "final": final, "violations": violations,
+            "anomalies": anomalies, "per_task": per_task}

@@ -174,15 +174,28 @@ def _debug_funnel(query: dict) -> dict:
     task_filter = query.get("task_id")
     if task_filter is not None:
         tasks = {t: v for t, v in tasks.items() if t == task_filter}
-    return {"stages": list(funnel.STAGES), "tasks": tasks}
+    # cross-task totals + conservation verdict: the operator view that
+    # otherwise requires summing per-task ledgers by hand.  ?final=1
+    # applies post-drain strictness (every residual must be zero).
+    final = query.get("final") in ("1", "true")
+    return {"stages": list(funnel.STAGES), "tasks": tasks,
+            "aggregate": funnel.aggregate(tasks),
+            "conservation": funnel.conservation(tasks, final=final)}
 
 
 def _debug_slo(query: dict) -> dict:
-    from janus_tpu import slo
+    from janus_tpu import funnel, slo
 
     engine = slo.get_engine()
     engine.sample()
-    return engine.evaluate()
+    report = engine.evaluate()
+    # the funnel feeds two SLIs (upload_acceptance, prepare_success); give
+    # the operator the cross-task totals + conservation verdict alongside
+    # the burn rates so a burning SLI can be traced to its loss stage
+    tasks = funnel.snapshot()
+    report["funnel"] = {"aggregate": funnel.aggregate(tasks),
+                        "conservation": funnel.conservation(tasks)}
+    return report
 
 
 def _debug_watchdog(query: dict) -> dict:

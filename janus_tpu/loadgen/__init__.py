@@ -1,0 +1,39 @@
+"""Production soak harness: open-loop load generation that drives the
+observability stack (funnel / SLO burn-rate engine / watchdog) at
+production shape, a cross-service telemetry scraper, and the
+funnel-conservation audit.
+
+Pieces (see docs/SOAK.md):
+
+  * ``schedule``  — open-loop arrival processes (Poisson, diurnal ramp)
+  * ``faults``    — adversarial report mutation (malformed / replayed /
+    expired / clock-skewed) at a configurable fraction
+  * ``generator`` — the load generator proper: mixed-VDAF task matrix,
+    worker pool, per-upload latency + outcome accounting
+  * ``scraper``   — polls every service's /metrics + /debug/{slo,funnel,
+    watchdog} endpoints on an interval, keeping burn-rate trajectories
+  * ``audit``     — joins the scraped per-service funnel ledgers and
+    runs the conservation audit (janus_tpu.funnel.conservation)
+  * ``artifact``  — assembles the SOAK_rNN.json artifact
+
+The top-level driver is ``soak.py`` at the repo root.
+"""
+
+from janus_tpu.loadgen.schedule import (  # noqa: F401
+    DiurnalSchedule,
+    PoissonSchedule,
+    make_schedule,
+)
+from janus_tpu.loadgen.faults import FaultInjector, FaultMix  # noqa: F401
+from janus_tpu.loadgen.generator import (  # noqa: F401
+    LoadConfig,
+    LoadGenerator,
+    UploadOutcome,
+)
+from janus_tpu.loadgen.scraper import Scraper, parse_histogram  # noqa: F401
+from janus_tpu.loadgen.audit import funnel_conservation_audit  # noqa: F401
+from janus_tpu.loadgen.artifact import (  # noqa: F401
+    build_artifact,
+    next_artifact_path,
+    percentiles,
+)

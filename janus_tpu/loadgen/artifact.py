@@ -1,0 +1,182 @@
+"""SOAK_rNN.json artifact assembly.
+
+The artifact is the soak run's single deliverable: offered vs sustained
+throughput, upload/aggregate latency percentiles, per-SLI burn-rate
+trajectories with fired/cleared alert analysis, watchdog stall events,
+and the funnel-conservation verdict.  Mirrors bench.py's BENCH_rNN.json
+numbering so `python -m janus_tpu.tools bench-diff` can compare runs of
+either kind.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+
+from janus_tpu.loadgen.faults import ACCEPTANCE_BURNING
+
+
+def percentiles(samples, qs=(0.5, 0.99, 0.999)) -> dict | None:
+    """Interpolated percentiles of raw samples: {"p50": .., "p99": ..,
+    "p999": .., "count": n}; None when empty."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    n = len(ordered)
+    out = {}
+    for q in qs:
+        pos = q * (n - 1)
+        lo = int(pos)
+        hi = min(lo + 1, n - 1)
+        frac = pos - lo
+        value = ordered[lo] * (1 - frac) + ordered[hi] * frac
+        out[f"p{q * 100:g}".replace(".", "")] = round(value, 6)
+    out["count"] = n
+    return out
+
+
+def _timeline(outcomes, duration_s: float, buckets: int = 10) -> list:
+    """Per-slice accepted/rejected/error counts — the sustained-rate
+    shape (a diurnal run shows the ramp here)."""
+    width = duration_s / buckets
+    rows = [{"t0": round(i * width, 2), "t1": round((i + 1) * width, 2),
+             "accepted": 0, "rejected": 0, "errors": 0}
+            for i in range(buckets)]
+    for o in outcomes:
+        i = min(int(o.t_offset / width), buckets - 1)
+        if o.status == "accepted":
+            rows[i]["accepted"] += 1
+        elif o.status.startswith("rejected:"):
+            rows[i]["rejected"] += 1
+        else:
+            rows[i]["errors"] += 1
+    return rows
+
+
+def _alert_analysis(slo_series: dict) -> dict:
+    """Fired/cleared timestamps per SLI from the scraped burn-rate
+    trajectories, taking the worst burn across services at each tick
+    (the composed topology runs one engine per process)."""
+    merged: dict = {}
+    for points in slo_series.values():
+        for p in points:
+            for sli, v in p.get("slos", {}).items():
+                merged.setdefault(sli, []).append(
+                    (p["t"], v.get("fast_burn"), v.get("slow_burn"),
+                     bool(v.get("alerting"))))
+    analysis = {}
+    for sli, rows in merged.items():
+        rows.sort(key=lambda r: r[0])
+        fired_at = cleared_at = None
+        max_fast = max_slow = 0.0
+        for t, fast, slow, alerting in rows:
+            max_fast = max(max_fast, fast or 0.0)
+            max_slow = max(max_slow, slow or 0.0)
+            if alerting and fired_at is None:
+                fired_at = t
+            if fired_at is not None and cleared_at is None and not alerting:
+                cleared_at = t
+            if alerting:
+                cleared_at = None  # re-fired; clearing must be last state
+        analysis[sli] = {
+            "fired": fired_at is not None,
+            "fired_at_s": fired_at,
+            "cleared": fired_at is not None and cleared_at is not None,
+            "cleared_at_s": cleared_at,
+            "max_fast_burn": round(max_fast, 4),
+            "max_slow_burn": round(max_slow, 4),
+            "samples": len(rows),
+        }
+    return analysis
+
+
+def build_artifact(*, config: dict, generator, scraper, audit: dict,
+                   acceptance_objective: float = 0.99,
+                   burn_alert: float = 2.0, collections: list | None = None,
+                   wall_s: float | None = None) -> dict:
+    """Assemble the artifact dict from a finished run's pieces."""
+    summary = generator.summary()
+    upload_latencies = [o.latency_s for o in generator.outcomes
+                        if o.status == "accepted"]
+    burning = sum(generator.injected.get(k, 0) for k in ACCEPTANCE_BURNING)
+    uploaded = summary["completed"] or 1
+    bad_fraction = burning / uploaded
+    latency = {
+        "upload_s": percentiles(upload_latencies),
+        "agg_step_s": scraper.latency_quantiles("janus_job_step_time"),
+        "http_request_s": scraper.latency_quantiles(
+            "janus_http_request_duration_seconds"),
+    }
+    conservation = {k: v for k, v in audit.items() if k != "merged"}
+    return {
+        "kind": "soak",
+        "schema": 1,
+        "run": dict(config, wall_s=round(wall_s, 2) if wall_s else None),
+        "throughput": {
+            "offered": summary["offered"],
+            "completed": summary["completed"],
+            "accepted": summary["accepted"],
+            "sustained_accepted_rps": summary["sustained_accepted_rps"],
+            "by_status": summary["by_status"],
+            "max_arrival_lag_s": summary["max_arrival_lag_s"],
+            "timeline": _timeline(generator.outcomes,
+                                  generator.config.duration_s),
+        },
+        "latency": latency,
+        "faults": {
+            "injected": summary["injected_faults"],
+            "fault_outcomes": summary["fault_outcomes"],
+            "acceptance_burning": burning,
+            "actual_bad_fraction": round(bad_fraction, 5),
+            # what the injected mix SHOULD drive the fast-window burn to
+            "expected_burn": round(
+                bad_fraction / (1.0 - acceptance_objective), 3),
+        },
+        "slo": {
+            "burn_alert_threshold": burn_alert,
+            "acceptance_objective": acceptance_objective,
+            "alerts": _alert_analysis(scraper.slo_series),
+            "series": scraper.slo_series,
+        },
+        "watchdog": {
+            "stall_events": scraper.stall_events,
+            "final": scraper.watchdog_last,
+        },
+        "funnel": {
+            "tasks": audit.get("merged", {}),
+            "aggregate": audit.get("aggregate", {}),
+            "conservation": conservation,
+        },
+        "collections": collections or [],
+        "scrape": {
+            "interval_s": scraper.interval_s,
+            "scrapes": scraper.scrapes,
+            "errors": scraper.errors,
+            "services": [name for name, _ in scraper.services],
+        },
+        "environment": {
+            "python": sys.version.split()[0],
+            "platform": platform.platform(),
+            "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        },
+    }
+
+
+def next_artifact_path(repo_dir: str, prefix: str = "SOAK") -> str:
+    """First free ``{prefix}_rNN.json`` under ``repo_dir`` (same
+    numbering convention as bench.py's BENCH_rNN.json)."""
+    n = 1
+    while True:
+        path = os.path.join(repo_dir, f"{prefix}_r{n:02d}.json")
+        if not os.path.exists(path):
+            return path
+        n += 1
+
+
+def write_artifact(artifact: dict, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return path

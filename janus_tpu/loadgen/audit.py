@@ -1,0 +1,42 @@
+"""Funnel-conservation audit over the scraped per-service ledgers.
+
+The soak's accounting backbone: every report the generator uploaded must
+be explained by the joined leader+helper funnel — validated or rejected,
+stored or deduped, prepared or lost.  The join matters because the
+multi-process topology splits the leader's stages across processes
+(upload/store in the aggregator, agg_init/prepare_done in the
+aggregation job driver, collected in the collection job driver).
+"""
+
+from __future__ import annotations
+
+from janus_tpu import funnel
+
+
+def funnel_conservation_audit(service_funnels, final: bool = True,
+                              uploaded_expected: int | None = None) -> dict:
+    """Join the per-service ``/debug/funnel`` ``tasks`` payloads and run
+    the conservation audit.
+
+    ``final=True`` applies post-drain strictness: residuals must be zero
+    and leader/helper must agree on agg_init/prepare_done.  When the
+    generator's own accepted+rejected count is passed as
+    ``uploaded_expected``, the audit additionally cross-checks the
+    leader ledger's ``uploaded`` total against it (a report the funnel
+    never saw is loss the ledger cannot explain).
+    """
+    merged = funnel.merge_snapshots(service_funnels)
+    verdict = funnel.conservation(merged, final=final)
+    verdict["merged"] = merged
+    verdict["aggregate"] = funnel.aggregate(merged)
+    if uploaded_expected is not None:
+        seen = (verdict["aggregate"]["roles"].get("leader", {})
+                .get("stages", {}).get("uploaded", 0))
+        verdict["uploaded_expected"] = uploaded_expected
+        verdict["uploaded_seen"] = seen
+        if seen != uploaded_expected:
+            verdict["violations"].append(
+                f"leader funnel saw {seen} uploaded report(s) but the "
+                f"generator submitted {uploaded_expected}")
+            verdict["ok"] = False
+    return verdict

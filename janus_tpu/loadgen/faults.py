@@ -1,0 +1,109 @@
+"""Adversarial report mutation for the soak harness.
+
+Four fault kinds, each chosen so the leader's funnel accounts it under a
+known bucket (the soak's burn-rate and conservation checks depend on the
+mapping):
+
+  * ``malformed``     — the leader input-share ciphertext is tampered
+    post-seal; HPKE open fails -> ``rejected_decrypt_failure``
+  * ``replayed``      — an earlier ACCEPTED report's exact bytes are
+    re-uploaded; it re-validates, then the store transaction dedups it
+    -> ``rejected_duplicate`` (an IN-STORE reject: it does NOT burn the
+    upload_acceptance SLI, by design — replays are not client errors)
+  * ``expired``       — report timestamp older than the task's
+    report_expiry_age -> ``rejected_expired``
+  * ``clock_skewed``  — report timestamp past now + tolerable_clock_skew
+    -> ``rejected_too_early``
+
+``malformed``/``expired``/``clock_skewed`` reject before ``validated``
+and therefore burn the upload_acceptance SLI; the expected burn of a run
+is computed from the ACTUAL injected counts the generator records.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, fields
+
+from janus_tpu.messages import HpkeCiphertext, Report
+
+FAULT_KINDS = ("malformed", "replayed", "expired", "clock_skewed")
+
+# fault kinds that reject between `uploaded` and `validated`, i.e. the
+# ones the upload_acceptance SLI counts as errors
+ACCEPTANCE_BURNING = ("malformed", "expired", "clock_skewed")
+
+
+@dataclass
+class FaultMix:
+    """Relative weights of the fault kinds (normalized on use)."""
+
+    malformed: float = 0.4
+    replayed: float = 0.3
+    expired: float = 0.15
+    clock_skewed: float = 0.15
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultMix":
+        """``malformed=0.5,replayed=0.5`` (unnamed kinds weigh 0)."""
+        weights = {f.name: 0.0 for f in fields(cls)}
+        for part in spec.split(","):
+            name, _, val = part.partition("=")
+            name = name.strip()
+            if name not in weights:
+                raise ValueError(f"unknown fault kind {name!r} "
+                                 f"(one of {FAULT_KINDS})")
+            weights[name] = float(val)
+        if sum(weights.values()) <= 0:
+            raise ValueError("fault mix weights sum to zero")
+        return cls(**weights)
+
+    def pick(self, rng: random.Random) -> str:
+        kinds = [f.name for f in fields(self)]
+        weights = [getattr(self, k) for k in kinds]
+        return rng.choices(kinds, weights=weights, k=1)[0]
+
+
+class FaultInjector:
+    """Decides, per arrival, whether to corrupt the upload and how.
+
+    ``fraction`` is the probability of a fault while the arrival's
+    progress (t/duration) lies inside ``window`` — injecting only during
+    a window lets the run demonstrate the SLO alert both FIRING (during)
+    and CLEARING (after), which a constant fault rate cannot.
+    """
+
+    def __init__(self, fraction: float, mix: FaultMix, rng: random.Random,
+                 window: tuple[float, float] = (0.0, 1.0)):
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        self.fraction = fraction
+        self.mix = mix
+        self.rng = rng
+        self.window = window
+
+    def decide(self, progress: float) -> str | None:
+        """The fault kind for an arrival at ``progress`` in [0,1), or
+        None for a clean upload."""
+        if not self.window[0] <= progress < self.window[1]:
+            return None
+        if self.fraction and self.rng.random() < self.fraction:
+            return self.mix.pick(self.rng)
+        return None
+
+
+def tamper_leader_ciphertext(report: Report) -> Report:
+    """Flip the last payload byte of the LEADER input-share ciphertext.
+
+    The report stays wire-decodable (so the funnel counts it
+    ``uploaded``) but the leader's HPKE open fails deterministically.
+    Only the leader share is touched: tampering the HELPER ciphertext
+    would pass leader validation and surface later as helper prepare
+    loss, which would (correctly!) fail the conservation audit.
+    """
+    ct = report.leader_encrypted_input_share
+    payload = bytes(ct.payload)
+    bad = payload[:-1] + bytes([payload[-1] ^ 0xFF])
+    return Report(report.metadata, report.public_share,
+                  HpkeCiphertext(ct.config_id, ct.encapsulated_key, bad),
+                  report.helper_encrypted_input_share)

@@ -1,0 +1,270 @@
+"""The open-loop load generator proper.
+
+One arrival thread walks the schedule's offsets against a monotonic
+clock and hands each upload to a worker pool — if the servers fall
+behind, arrivals keep coming and backlog accrues in the pool queue (the
+open-loop property the SLO latency measurements depend on).  Each
+arrival targets a task drawn from the mixed-VDAF workload matrix and,
+with the configured probability inside the fault window, is corrupted
+by one of the ``faults`` mutations before upload.
+
+Uploads go over real HTTP (both the in-process pair and the composed
+topology expose DAP listeners), so a rejection surfaces as an RFC-7807
+problem document; outcomes are recorded as ``accepted``,
+``rejected:<title>`` or ``error:<exception>`` together with the upload
+round-trip latency.  The generator keeps the ACTUAL per-kind injected
+counts — the artifact computes expected SLI burn from those, not from
+the configured fraction.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable
+
+from janus_tpu.loadgen.faults import FaultInjector, FaultMix, tamper_leader_ciphertext
+from janus_tpu.loadgen.schedule import make_schedule
+from janus_tpu.messages import Duration, Report
+
+
+class UploadRejected(Exception):
+    """The leader turned the upload away with a problem document."""
+
+    def __init__(self, reason: str, status: int):
+        super().__init__(f"{reason} (HTTP {status})")
+        self.reason = reason
+        self.status = status
+
+
+class HttpUploader:
+    """PUTs encoded reports to the leader's upload resource.
+
+    requests.Session is not safe for concurrent use, so each worker
+    thread lazily gets its own keep-alive session.
+    """
+
+    def __init__(self, leader_endpoint: str, task_id):
+        self.task_id = task_id
+        self.url = (leader_endpoint.rstrip("/")
+                    + f"/tasks/{task_id}/reports")
+        self._local = threading.local()
+
+    def _session(self):
+        session = getattr(self._local, "session", None)
+        if session is None:
+            import requests
+
+            session = self._local.session = requests.Session()
+        return session
+
+    def __call__(self, body: bytes) -> None:
+        resp = self._session().put(
+            self.url, data=body,
+            headers={"Content-Type": Report.MEDIA_TYPE})
+        if resp.status_code in (200, 201):
+            return
+        reason = f"http_{resp.status_code}"
+        try:
+            doc = resp.json()
+            reason = doc.get("title") or reason
+        except Exception:
+            pass
+        raise UploadRejected(reason, resp.status_code)
+
+
+@dataclass
+class TaskWorkload:
+    """One task in the load matrix: a client that can shard reports for
+    it, a measurement sampler, and the task timing parameters the fault
+    mutations need."""
+
+    name: str
+    client: object  # janus_tpu.client.Client with HPKE configs resolved
+    upload: Callable[[bytes], None]
+    measure: Callable[[random.Random], object]
+    time_precision_s: int
+    tolerable_clock_skew_s: int
+    report_expiry_age_s: int | None = None
+    replay_capacity: int = 256
+
+    def __post_init__(self):
+        self._replays: collections.deque = collections.deque(  # janus-lint: disable=guarded-write-unlocked -- field construction; no other thread holds a reference yet
+            maxlen=self.replay_capacity)
+        self._replay_lock = threading.Lock()
+
+    def remember_accepted(self, body: bytes) -> None:
+        with self._replay_lock:
+            self._replays.append(body)
+
+    def take_replay(self, rng: random.Random) -> bytes | None:
+        with self._replay_lock:
+            if not self._replays:
+                return None
+            return self._replays[rng.randrange(len(self._replays))]
+
+
+@dataclass
+class UploadOutcome:
+    """One upload's accounting record."""
+
+    t_offset: float          # arrival offset from run start, seconds
+    task: str
+    fault: str | None        # fault actually applied (None = clean)
+    status: str              # accepted | rejected:<title> | error:<type>
+    latency_s: float         # upload round-trip only (open-loop latency)
+
+
+@dataclass
+class LoadConfig:
+    duration_s: float = 60.0
+    rate_rps: float = 50.0
+    schedule: str = "poisson"
+    fault_fraction: float = 0.0
+    fault_mix: FaultMix = field(default_factory=FaultMix)
+    fault_window: tuple = (0.0, 1.0)
+    workers: int = 16
+    seed: int = 1
+
+
+class LoadGenerator:
+    """Drives the workload matrix per ``LoadConfig``; ``run()`` blocks
+    until the schedule is exhausted and every in-flight upload resolved."""
+
+    def __init__(self, config: LoadConfig, workloads: list):
+        if not workloads:
+            raise ValueError("need at least one TaskWorkload")
+        self.config = config
+        self.workloads = list(workloads)
+        self.outcomes: list[UploadOutcome] = []
+        self.injected: collections.Counter = collections.Counter()
+        self.offered = 0
+        self.max_lag_s = 0.0  # worst arrival-loop scheduling slip
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- the arrival loop --------------------------------------------------
+
+    def run(self) -> None:
+        cfg = self.config
+        rng = random.Random(cfg.seed)
+        schedule = make_schedule(cfg.schedule, cfg.rate_rps)
+        injector = FaultInjector(cfg.fault_fraction, cfg.fault_mix,
+                                 random.Random(cfg.seed + 1),
+                                 window=cfg.fault_window)
+        start = time.monotonic()
+        with ThreadPoolExecutor(max_workers=cfg.workers,
+                                thread_name_prefix="loadgen") as pool:
+            for offset in schedule.arrivals(cfg.duration_s, rng):
+                if self._stop.is_set():
+                    break
+                lag = (time.monotonic() - start) - offset
+                if lag < 0:
+                    time.sleep(-lag)
+                elif lag > self.max_lag_s:
+                    self.max_lag_s = lag
+                workload = rng.choice(self.workloads)
+                fault = injector.decide(offset / cfg.duration_s)
+                measurement = workload.measure(rng)
+                self.offered += 1
+                # worker rng seeded per arrival: deterministic under the
+                # run seed yet race-free across pool threads
+                pool.submit(self._one_upload, workload, measurement, fault,
+                            offset,
+                            random.Random(cfg.seed * 1000003 + self.offered))
+            # pool __exit__ waits for the in-flight tail
+
+    # -- one upload --------------------------------------------------------
+
+    def _one_upload(self, workload: TaskWorkload, measurement, fault,
+                    offset: float, rng: random.Random) -> None:
+        applied = fault
+        body = None
+        try:
+            if applied == "replayed":
+                body = workload.take_replay(rng)
+                if body is None:  # nothing accepted yet; degrade to clean
+                    applied = None
+            if applied == "expired" and workload.report_expiry_age_s is None:
+                applied = None  # task keeps reports forever; cannot expire
+            if body is None:
+                body = self._build_report(workload, measurement, applied)
+        except Exception as e:
+            self._record(offset, workload.name, applied,
+                         f"error:{type(e).__name__}", 0.0)
+            return
+
+        t0 = time.monotonic()
+        try:
+            workload.upload(body)
+            status = "accepted"
+        except UploadRejected as e:
+            status = f"rejected:{e.reason}"
+        except Exception as e:
+            status = f"error:{type(e).__name__}"
+        latency = time.monotonic() - t0
+        if status == "accepted" and applied is None:
+            workload.remember_accepted(body)
+        self._record(offset, workload.name, applied, status, latency)
+
+    def _build_report(self, workload: TaskWorkload, measurement,
+                      fault) -> bytes:
+        client = workload.client
+        report_time = None
+        if fault == "expired":
+            # older than report_expiry_age even after the server's own
+            # clock advances and prepare_report's round-down
+            report_time = client.clock.now().sub(Duration(
+                workload.report_expiry_age_s
+                + 2 * workload.time_precision_s))
+        elif fault == "clock_skewed":
+            # past now + tolerable_clock_skew even after round-down
+            report_time = client.clock.now().add(Duration(
+                workload.tolerable_clock_skew_s
+                + 2 * workload.time_precision_s))
+        report = client.prepare_report(measurement, time=report_time)
+        if fault == "malformed":
+            report = tamper_leader_ciphertext(report)
+        return report.encode()
+
+    def _record(self, offset: float, task: str, fault, status: str,
+                latency_s: float) -> None:
+        with self._lock:
+            self.outcomes.append(UploadOutcome(
+                round(offset, 4), task, fault, status, round(latency_s, 6)))
+            if fault is not None:
+                self.injected[fault] += 1
+
+    # -- post-run accounting ----------------------------------------------
+
+    def summary(self) -> dict:
+        with self._lock:
+            outcomes = list(self.outcomes)
+            injected = dict(self.injected)
+        by_status: collections.Counter = collections.Counter()
+        by_fault_status: dict = {}
+        for o in outcomes:
+            by_status[o.status] += 1
+            if o.fault is not None:
+                by_fault_status.setdefault(o.fault, collections.Counter())[
+                    o.status] += 1
+        accepted = by_status.get("accepted", 0)
+        return {
+            "offered": self.offered,
+            "completed": len(outcomes),
+            "accepted": accepted,
+            "by_status": dict(by_status),
+            "injected_faults": injected,
+            "fault_outcomes": {k: dict(v)
+                               for k, v in sorted(by_fault_status.items())},
+            "max_arrival_lag_s": round(self.max_lag_s, 4),
+            "sustained_accepted_rps": round(
+                accepted / self.config.duration_s, 2),
+        }

@@ -1,0 +1,192 @@
+"""Cross-service telemetry scraper for the soak harness.
+
+One daemon thread polls every service's observability surface on an
+interval:
+
+    /metrics          -> Prometheus text (histograms for latency SLIs)
+    /debug/slo        -> burn rates per SLI (each request samples the
+                         engine, so the scrape interval IS the SLO
+                         sampling cadence)
+    /debug/funnel     -> per-task report-lifecycle ledger (the audit
+                         joins the per-service payloads)
+    /debug/watchdog   -> stall-detector verdict
+
+and keeps time series of the burn rates plus the latest funnel/watchdog
+snapshots.  In the composed topology the five services each serve their
+own slice of the ledger; in-process one health server carries all of it
+— the scraper is agnostic, it just records per (service, endpoint).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+_BUCKET_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{(?P<labels>[^}]*)\}'
+    r'\s+(?P<value>[0-9.eE+-]+)\s*$')
+_SUM_COUNT_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_(?P<kind>sum|count)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[0-9.eE+-]+)\s*$')
+
+
+def parse_histogram(text: str, name: str):
+    """Sum a histogram across its label sets in a Prometheus exposition.
+
+    Returns ``(bounds, counts, total_sum, total_count)`` where ``counts``
+    is per-bucket (non-cumulative) with a final +Inf overflow entry —
+    the shape ``slo._quantile(bounds, counts, q)`` consumes.  Returns
+    None when the metric is absent.
+    """
+    # per label set: {le: cumulative}
+    by_labels: dict = {}
+    total_sum = 0.0
+    total_count = 0
+    seen = False
+    for line in text.splitlines():
+        m = _BUCKET_RE.match(line)
+        if m and m.group("name") == name:
+            labels = m.group("labels")
+            le = None
+            rest = []
+            for part in labels.split(","):
+                k, _, v = part.partition("=")
+                if k == "le":
+                    le = v.strip('"')
+                else:
+                    rest.append(part)
+            if le is None:
+                continue
+            key = ",".join(sorted(rest))
+            bound = float("inf") if le == "+Inf" else float(le)
+            by_labels.setdefault(key, {})[bound] = float(m.group("value"))
+            seen = True
+            continue
+        m = _SUM_COUNT_RE.match(line)
+        if m and m.group("name") == name:
+            if m.group("kind") == "sum":
+                total_sum += float(m.group("value"))
+            else:
+                total_count += int(float(m.group("value")))
+            seen = True
+    if not seen:
+        return None
+    bounds = sorted({b for les in by_labels.values() for b in les
+                     if b != float("inf")})
+    counts = [0] * (len(bounds) + 1)
+    for les in by_labels.values():
+        prev = 0.0
+        for i, b in enumerate(bounds):
+            cum = les.get(b, prev)
+            counts[i] += int(cum - prev)
+            prev = cum
+        counts[-1] += int(les.get(float("inf"), prev) - prev)
+    return bounds, counts, total_sum, total_count
+
+
+class Scraper(threading.Thread):
+    """Polls ``services`` (name, base_url pairs) every ``interval_s``."""
+
+    def __init__(self, services, interval_s: float = 1.0):
+        super().__init__(name="soak-scraper", daemon=True)
+        self.services = list(services)
+        self.interval_s = interval_s
+        self._stop_evt = threading.Event()
+        self._session_local = threading.local()
+        self._t0 = time.monotonic()
+        # results
+        self.slo_series: dict = {name: [] for name, _ in self.services}
+        self.funnel_last: dict = {}    # service -> /debug/funnel "tasks"
+        self.watchdog_last: dict = {}  # service -> last verdict
+        self.stall_events: list = []   # [{"t", "service", "stalls"}]
+        self.metrics_last: dict = {}   # service -> exposition text
+        self.scrapes = 0
+        self.errors: dict = {}         # service -> error count
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _session(self):
+        s = getattr(self._session_local, "session", None)
+        if s is None:
+            import requests
+
+            s = self._session_local.session = requests.Session()
+        return s
+
+    def _get(self, base: str, path: str, json_body: bool = True):
+        resp = self._session().get(base.rstrip("/") + path, timeout=10)
+        resp.raise_for_status()
+        return resp.json() if json_body else resp.text
+
+    # -- the scrape loop ---------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            self.tick()
+
+    def stop(self, final_tick: bool = True) -> None:
+        self._stop_evt.set()
+        if self.is_alive():
+            self.join(timeout=max(self.interval_s * 2, 15))
+        if final_tick:
+            self.tick()
+
+    def tick(self) -> None:
+        t = round(time.monotonic() - self._t0, 3)
+        self.scrapes += 1
+        for name, base in self.services:
+            try:
+                self._scrape_one(name, base, t)
+            except Exception:
+                self.errors[name] = self.errors.get(name, 0) + 1
+
+    def _scrape_one(self, name: str, base: str, t: float) -> None:
+        self.metrics_last[name] = self._get(base, "/metrics",
+                                            json_body=False)
+        slo = self._get(base, "/debug/slo")
+        point = {"t": t, "alerting": slo.get("alerting", []), "slos": {}}
+        for sli, obj in (slo.get("slos") or {}).items():
+            windows = obj.get("windows", {})
+            point["slos"][sli] = {
+                "fast_burn": windows.get("fast", {}).get("burn_rate"),
+                "slow_burn": windows.get("slow", {}).get("burn_rate"),
+                "alerting": obj.get("alerting", False),
+                "budget_remaining": obj.get("budget_remaining"),
+            }
+        self.slo_series[name].append(point)
+        funnel = self._get(base, "/debug/funnel")
+        self.funnel_last[name] = funnel.get("tasks", {})
+        watchdog = self._get(base, "/debug/watchdog")
+        self.watchdog_last[name] = watchdog
+        if watchdog.get("stalls"):
+            self.stall_events.append(
+                {"t": t, "service": name, "stalls": watchdog["stalls"]})
+
+    # -- derived views -----------------------------------------------------
+
+    def merged_funnel(self) -> dict:
+        from janus_tpu import funnel
+
+        return funnel.merge_snapshots(self.funnel_last.values())
+
+    def latency_quantiles(self, metric: str, quantiles=(0.5, 0.99, 0.999)):
+        """Cross-service percentile estimates for a histogram metric,
+        interpolated from the summed bucket counts of the LAST scrape."""
+        from janus_tpu.slo import _quantile
+
+        bounds: list = []
+        counts: list = []
+        for text in self.metrics_last.values():
+            parsed = parse_histogram(text, metric)
+            if parsed is None:
+                continue
+            b, c, _, _ = parsed
+            if not bounds:
+                bounds, counts = list(b), list(c)
+            elif b == bounds:
+                counts = [x + y for x, y in zip(counts, c)]
+        if not bounds:
+            return None
+        return {f"p{q * 100:g}".replace(".", ""):
+                _quantile(bounds, counts, q) for q in quantiles}

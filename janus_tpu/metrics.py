@@ -50,6 +50,11 @@ class Counter:
         with self._lock:
             return sorted(self._values.items())
 
+    def reset(self) -> None:
+        """Drop every label set (tests, bench harnesses)."""
+        with self._lock:
+            self._values.clear()
+
     def _render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
@@ -102,6 +107,13 @@ class Histogram:
         with self._lock:
             return [(key, list(counts), self._sums.get(key, 0.0))
                     for key, counts in sorted(self._counts.items())]
+
+    def reset(self) -> None:
+        """Drop every label set, sum and exemplar (tests, bench)."""
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._exemplars.clear()
 
     def exemplars_snapshot(self) -> list[tuple]:
         """[(label_key, [exemplar|None per bucket])] — exemplar is
@@ -158,6 +170,11 @@ class Gauge:
         """[(label_key, value)] for exporters (janus_tpu.otlp)."""
         with self._lock:
             return sorted(self._values.items())
+
+    def reset(self) -> None:
+        """Drop every label set (tests, bench harnesses)."""
+        with self._lock:
+            self._values.clear()
 
     def _render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -242,6 +259,20 @@ class Registry:
     def all(self) -> list:
         with self._lock:
             return list(self._metrics)
+
+    def reset_instrument(self, name: str) -> bool:
+        """Reset every label set of the named instrument through its
+        public ``reset()`` (the registry keeps at most one instrument per
+        (name, type) pair per type, but a name can exist as several
+        types, so all matches reset).  Returns True if any instrument was
+        found.  This is the sanctioned way for harnesses (tests, bench,
+        soak) to zero an instrument — reaching into ``_values``/``_lock``
+        privates violates the lock discipline janus-lint enforces."""
+        with self._lock:
+            matches = [m_ for m_ in self._metrics if m_.name == name]
+        for m_ in matches:
+            m_.reset()
+        return bool(matches)
 
 
 REGISTRY = Registry()

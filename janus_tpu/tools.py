@@ -7,6 +7,7 @@ tools/src/bin/{collect,dap_decode,hpke_keygen}.rs).
     python -m janus_tpu.tools hpke-keygen [--id N]
     python -m janus_tpu.tools dap-decode --media-type TYPE FILE
     python -m janus_tpu.tools collect --task-id .. --leader URL ...
+    python -m janus_tpu.tools bench-diff A.json B.json [--threshold 0.1]
 """
 
 from __future__ import annotations
@@ -216,6 +217,106 @@ def cmd_collect(args) -> int:
     return 0
 
 
+# -- bench-diff: artifact regression gate ----------------------------------
+
+
+def _load_perf_artifact(path: str) -> dict:
+    """Load a BENCH/SOAK artifact in any of its shapes: a single JSON
+    document (soak.py, driver-captured BENCH_rNN.json wrappers with a
+    ``parsed`` payload) or bench.py's raw two-JSON-line stdout."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc.update(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        if not doc:
+            raise SystemExit(f"{path}: not a JSON artifact")
+    if isinstance(doc.get("parsed"), dict):  # driver wrapper
+        doc = doc["parsed"]
+    return doc
+
+
+def _perf_metrics(doc: dict) -> dict:
+    """Flatten an artifact to comparable metrics:
+    ``{name: (value, "higher"|"lower")}`` — the direction that counts as
+    better."""
+    out: dict = {}
+
+    def put(name, value, better):
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            out[name] = (float(value), better)
+
+    if doc.get("kind") == "soak":
+        thr = doc.get("throughput", {})
+        put("sustained_accepted_rps", thr.get("sustained_accepted_rps"),
+            "higher")
+        for metric, entry in (doc.get("latency") or {}).items():
+            for q in ("p50", "p99", "p999"):
+                if isinstance(entry, dict):
+                    put(f"{metric}.{q}", entry.get(q), "lower")
+        # end-of-run budget per SLI: spend more of it and you regressed
+        for service_points in (doc.get("slo", {}).get("series")
+                               or {}).values():
+            if not service_points:
+                continue
+            for sli, v in (service_points[-1].get("slos") or {}).items():
+                put(f"budget_remaining.{sli}", v.get("budget_remaining"),
+                    "higher")
+    else:  # bench.py record
+        put("reports_per_s", doc.get("value"), "higher")
+        for config, entry in (doc.get("detail") or {}).items():
+            if isinstance(entry, dict):
+                put(f"{config}.reports_per_sec",
+                    entry.get("reports_per_sec"), "higher")
+    return out
+
+
+def cmd_bench_diff(args) -> int:
+    """Compare two artifacts; exit 1 when any shared metric regresses
+    past the threshold (CI gate for BENCH/SOAK runs)."""
+    a = _perf_metrics(_load_perf_artifact(args.baseline))
+    b = _perf_metrics(_load_perf_artifact(args.candidate))
+    shared = sorted(set(a) & set(b))
+    if not shared:
+        print("bench-diff: no comparable metrics between the two artifacts",
+              file=sys.stderr)
+        return 2
+    regressions = 0
+    print(f"{'metric':<40} {'baseline':>12} {'candidate':>12} "
+          f"{'change':>8}  verdict")
+    for name in shared:
+        av, better = a[name]
+        bv, _ = b[name]
+        if av == 0:
+            change = 0.0 if bv == 0 else float("inf")
+        else:
+            change = (bv - av) / abs(av)
+        # direction-adjust so positive `worse` always means regression
+        worse = -change if better == "higher" else change
+        regressed = worse > args.threshold
+        regressions += regressed
+        verdict = "REGRESSED" if regressed else (
+            "improved" if worse < -args.threshold else "ok")
+        print(f"{name:<40} {av:>12.4g} {bv:>12.4g} {change:>+7.1%}  "
+              f"{verdict}")
+    if regressions:
+        print(f"bench-diff: {regressions} metric(s) regressed more than "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print(f"bench-diff: no regression beyond {args.threshold:.0%} "
+          f"across {len(shared)} metric(s)")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="janus_tpu.tools")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -260,6 +361,15 @@ def main(argv=None) -> int:
     p.add_argument("--batch-id")
     p.add_argument("--timeout", type=float, default=300.0)
     p.set_defaults(fn=cmd_collect)
+
+    p = sub.add_parser("bench-diff",
+                       help="compare two BENCH/SOAK artifacts; exit 1 on "
+                            "regression past --threshold")
+    p.add_argument("baseline")
+    p.add_argument("candidate")
+    p.add_argument("--threshold", type=float, default=0.1,
+                   help="relative regression tolerance (default 0.1 = 10%%)")
+    p.set_defaults(fn=cmd_bench_diff)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,647 @@
+#!/usr/bin/env python
+"""Production-shaped soak of the full DAP pipeline + observability stack.
+
+Drives an open-loop load (Poisson or diurnal-ramp arrivals, mixed-VDAF
+task matrix, a configurable adversarial fraction of malformed / replayed
+/ expired / clock-skewed reports) against either:
+
+  * ``--mode inprocess`` — a leader+helper Aggregator pair with real DAP
+    HTTP listeners plus the three background drivers (aggregation job
+    creator, aggregation job driver, collection job driver) as threads,
+    one health/debug listener, sqlite datastores; or
+  * ``--mode compose``   — the real five-process topology via
+    deploy/compose_e2e.ComposedTopology (the same commands the
+    docker-compose containers run), scraping every service's listener.
+
+While the load runs, a scraper thread polls /metrics + /debug/{slo,
+funnel,watchdog} on an interval (the scrape IS the SLO sampling
+cadence).  After the schedule is exhausted the run drains the pipeline,
+collects every task over the run interval, takes a final scrape, and
+runs the funnel-conservation audit over the joined leader+helper
+ledgers with post-drain strictness — every uploaded report must be
+validated-or-rejected, stored-or-deduped, prepared, and leader/helper
+must agree.  The artifact (SOAK_rNN.json) records sustained throughput,
+latency percentiles, per-SLI burn trajectories with alert fired/cleared
+analysis, watchdog stalls, and the conservation verdict.
+
+Exit status: 0 iff the conservation audit passes and every collection
+completed; 1 on unexplained loss (the soak's whole point).
+
+Examples:
+    python soak.py --duration 120 --rate 50 --bad-fraction 0.02 \
+        --bad-mix malformed=1 --fault-window 0.05,0.55 --burn-alert 1.5
+    python soak.py --mode compose --duration 90 --rate 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# The soak exercises the control plane + funnel accounting; the device
+# data plane is bench.py's job.  CPU keeps the run portable (callers can
+# still export JAX_PLATFORMS=tpu before invoking).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+# -- the mixed-VDAF task matrix --------------------------------------------
+# name -> (VdafInstance factory, provision-tasks JSON shape, measurement
+# sampler).  Small parameterizations: the soak measures pipeline + ledger
+# behavior under sustained load, not kernel throughput.
+
+def _vdaf_matrix():
+    from janus_tpu.models import VdafInstance
+
+    return {
+        "count": (lambda: VdafInstance.prio3_count(), "Prio3Count",
+                  lambda rng: rng.randint(0, 1)),
+        "sum": (lambda: VdafInstance.prio3_sum(8),
+                {"Prio3Sum": {"bits": 8}},
+                lambda rng: rng.randint(0, 255)),
+        "sumvec": (lambda: VdafInstance.prio3_sum_vec(1, 8, 3),
+                   {"Prio3SumVec": {"bits": 1, "length": 8,
+                                    "chunk_length": 3}},
+                   lambda rng: [rng.randint(0, 1) for _ in range(8)]),
+        "histogram": (lambda: VdafInstance.prio3_histogram(4, 2),
+                      {"Prio3Histogram": {"length": 4, "chunk_length": 2}},
+                      lambda rng: rng.randrange(4)),
+    }
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="open-loop soak of the DAP pipeline + observability")
+    ap.add_argument("--mode", choices=("inprocess", "compose"),
+                    default="inprocess")
+    ap.add_argument("--duration", type=float, default=120.0,
+                    help="load window in seconds")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="offered reports/s (peak rate for diurnal)")
+    ap.add_argument("--schedule", choices=("poisson", "diurnal"),
+                    default="poisson")
+    ap.add_argument("--tasks", type=int, default=4,
+                    help="number of concurrent tasks")
+    ap.add_argument("--vdafs", default="count,sum,sumvec,histogram",
+                    help="comma list from the matrix; tasks round-robin")
+    ap.add_argument("--bad-fraction", type=float, default=0.0,
+                    help="probability an arrival is adversarial "
+                         "(inside --fault-window)")
+    ap.add_argument("--bad-mix", default=None,
+                    help="fault-kind weights, e.g. malformed=0.5,replayed=0.5")
+    ap.add_argument("--fault-window", default="0.0,1.0",
+                    help="run-progress window [a,b) during which faults "
+                         "inject — a window ending before 1.0 lets the "
+                         "burn alert demonstrably CLEAR")
+    ap.add_argument("--scrape-interval", type=float, default=None,
+                    help="telemetry poll period (default: duration/60, "
+                         "clamped to [0.5, 5])")
+    ap.add_argument("--burn-alert", type=float, default=2.0,
+                    help="multi-window burn threshold for alerting")
+    ap.add_argument("--workers", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--drain-timeout", type=float, default=120.0)
+    ap.add_argument("--job-size", type=int, default=100,
+                    help="pin every aggregation job to exactly this many "
+                         "reports (one compiled bucket per VDAF; clean "
+                         "filler uploads round each task up post-load). "
+                         "0 restores free-form [1,100] job sizing")
+    ap.add_argument("--no-warm", action="store_true",
+                    help="skip pre-load kernel compilation (inprocess "
+                         "mode warms each VDAF's prepare kernels before "
+                         "the load window so compile cost never lands "
+                         "mid-soak)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: next SOAK_rNN.json)")
+    ap.add_argument("--db", default=None,
+                    help="inprocess mode: directory for file-backed sqlite "
+                         "datastores (default: in-memory)")
+    return ap.parse_args(argv)
+
+
+def _fault_window(spec: str) -> tuple:
+    a, _, b = spec.partition(",")
+    lo, hi = float(a), float(b)
+    if not 0.0 <= lo < hi <= 1.0:
+        raise SystemExit(f"bad --fault-window {spec!r} (need 0 <= a < b <= 1)")
+    return (lo, hi)
+
+
+# -- topology assembly ------------------------------------------------------
+
+
+class InProcessTopology:
+    """Leader+helper aggregators with DAP HTTP listeners, the three
+    drivers as daemon threads, one health/debug listener, and an SLO
+    engine with windows scaled to the run."""
+
+    def __init__(self, args, task_defs):
+        from janus_tpu import funnel, slo
+        from janus_tpu.aggregator import (
+            Aggregator, AggregatorConfig, DapHttpServer,
+        )
+        from janus_tpu.aggregator.aggregation_job_creator import (
+            AggregationJobCreator,
+        )
+        from janus_tpu.aggregator.aggregation_job_driver import (
+            AggregationJobDriver,
+        )
+        from janus_tpu.aggregator.collection_job_driver import (
+            CollectionJobDriver,
+        )
+        from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+        from janus_tpu.core.time import RealClock
+        from janus_tpu.datastore.datastore import (
+            Crypter, Datastore, SqliteBackend, ephemeral_datastore,
+        )
+        from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+        from janus_tpu.health import HealthServer
+        from janus_tpu.messages import Duration
+
+        funnel.clear()
+        clock = RealClock()
+        if args.db:
+            os.makedirs(args.db, exist_ok=True)
+
+            def make_ds(name):
+                ds = Datastore(SqliteBackend(os.path.join(args.db, name)),
+                               Crypter.generate(), clock)
+                ds.put_schema()
+                return ds
+
+            self.leader_ds, self.helper_ds = make_ds("leader.db"), make_ds(
+                "helper.db")
+        else:
+            self.leader_ds = ephemeral_datastore(clock)
+            self.helper_ds = ephemeral_datastore(clock)
+        shard = 4
+        self.helper_agg = Aggregator(
+            self.helper_ds, clock,
+            AggregatorConfig(batch_aggregation_shard_count=shard))
+        self.leader_agg = Aggregator(
+            self.leader_ds, clock,
+            AggregatorConfig(batch_aggregation_shard_count=shard))
+        self.helper_http = DapHttpServer(self.helper_agg).start()
+        self.leader_http = DapHttpServer(self.leader_agg).start()
+
+        self.builders = []
+        for vdaf_name, (factory, _json_shape, _measure) in task_defs:
+            b = TaskBuilder(QueryTypeCfg.time_interval(), factory())
+            b.with_min_batch_size(1)
+            b.with_report_expiry_age(Duration(7200))
+            b.leader_endpoint = self.leader_http.address
+            b.helper_endpoint = self.helper_http.address
+            self.helper_ds.run_tx(
+                "provision", lambda tx, b=b: tx.put_aggregator_task(
+                    b.helper_view()))
+            self.leader_ds.run_tx(
+                "provision", lambda tx, b=b: tx.put_aggregator_task(
+                    b.leader_view()))
+            self.builders.append((vdaf_name, b))
+
+        # background drivers, tuned for a short run (fast discovery).
+        # Pinning min==max job size keeps every job in ONE compiled
+        # bucket per VDAF (engine/batch.py bucket_size); the post-load
+        # top-up rounds each task to a job multiple so the tail drains.
+        min_job, max_job = ((args.job_size, args.job_size)
+                            if args.job_size else (1, 100))
+        self.creator = AggregationJobCreator(
+            self.leader_ds, min_job, max_job, tasks_update_frequency_s=1.0,
+            batch_aggregation_shard_count=shard)
+        agg_drv = AggregationJobDriver(self.leader_ds,
+                                       batch_aggregation_shard_count=shard)
+        coll_drv = CollectionJobDriver(self.leader_ds)
+        drv_cfg = JobDriverConfig(job_discovery_interval_s=0.5)
+        self.agg_driver = JobDriver(drv_cfg, agg_drv.acquirer, agg_drv.stepper,
+                                    agg_drv.abandon)
+        self.coll_driver = JobDriver(drv_cfg, coll_drv.acquirer,
+                                     coll_drv.stepper)
+        self.threads = [
+            threading.Thread(target=self.creator.run, daemon=True,
+                             name="soak-agg-creator"),
+            threading.Thread(target=self.agg_driver.run, daemon=True,
+                             name="soak-agg-driver"),
+            threading.Thread(target=self.coll_driver.run, daemon=True,
+                             name="soak-coll-driver"),
+        ]
+        for t in self.threads:
+            t.start()
+
+        # SLO windows scaled so the run spans several fast windows (the
+        # alert can fire AND clear inside the soak)
+        self.engine = slo.SloEngine(
+            fast_window_s=max(10.0, args.duration / 6),
+            slow_window_s=max(30.0, args.duration / 2),
+            burn_alert=args.burn_alert)
+        slo.set_engine(self.engine)
+        self.health = HealthServer(debug_console=True).start()
+
+    @property
+    def leader_url(self):
+        return self.leader_http.address
+
+    @property
+    def helper_url(self):
+        return self.helper_http.address
+
+    @property
+    def health_services(self):
+        return [("inproc", self.health.address)]
+
+    def flush_uploads(self):
+        self.leader_agg.report_writer.flush()
+
+    def collector_credentials(self, builder):
+        return builder.collector_auth_token, builder.collector_keypair
+
+    def stop(self):
+        from janus_tpu import slo
+
+        self.creator.stop()
+        self.agg_driver.stop()
+        self.coll_driver.stop()
+        for t in self.threads:
+            t.join(timeout=10)
+        self.leader_http.stop()
+        self.helper_http.stop()
+        self.health.stop()
+        slo.set_engine(None)
+
+
+class ComposeTopology:
+    """The real five-process topology (deploy/compose_e2e)."""
+
+    def __init__(self, args, task_defs):
+        from deploy.compose_e2e import ComposedTopology, TaskSpec
+
+        # the subprocess engines read their tuning from the environment
+        os.environ["JANUS_SLO_WINDOW_FAST_S"] = str(
+            max(10.0, args.duration / 6))
+        os.environ["JANUS_SLO_WINDOW_SLOW_S"] = str(
+            max(30.0, args.duration / 2))
+        os.environ["JANUS_SLO_BURN_ALERT"] = str(args.burn_alert)
+        min_job, max_job = ((args.job_size, args.job_size)
+                            if args.job_size else (1, 100))
+        self.topo = ComposedTopology(debug_console=True,
+                                     job_discovery_interval_s=0.5,
+                                     min_aggregation_job_size=min_job,
+                                     max_aggregation_job_size=max_job)
+        specs = []
+        for vdaf_name, (_factory, json_shape, _measure) in task_defs:
+            specs.append(TaskSpec(vdaf=json_shape, min_batch_size=1,
+                                  report_expiry_age_s=7200))
+        self.topo.provision(specs)
+        self.topo.start()
+        self.builders = list(zip([n for n, _ in task_defs], specs))
+
+    @property
+    def leader_url(self):
+        return self.topo.leader_url
+
+    @property
+    def helper_url(self):
+        return self.topo.helper_url
+
+    @property
+    def health_services(self):
+        return self.topo.health_services
+
+    def flush_uploads(self):
+        time.sleep(1.0)  # max_upload_batch_write_delay_ms is 250ms
+
+    def collector_credentials(self, spec):
+        return self.topo.col_token, self.topo.collector_kp
+
+    def stop(self):
+        self.topo.stop()
+
+
+# -- workload + collection --------------------------------------------------
+
+
+def build_workloads(args, topo, task_defs):
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.loadgen.generator import HttpUploader, TaskWorkload
+    from janus_tpu.messages import Duration, TaskId
+
+    workloads = []
+    for i, ((vdaf_name, (factory, _shape, measure)),
+            (name2, builder_or_spec)) in enumerate(
+                zip(task_defs, topo.builders)):
+        if args.mode == "inprocess":
+            task_id = builder_or_spec.task_id
+            precision = builder_or_spec.time_precision.seconds
+            skew = builder_or_spec.tolerable_clock_skew.seconds
+            expiry = builder_or_spec.report_expiry_age.seconds
+        else:
+            task_id = TaskId(builder_or_spec.task_id)
+            precision = builder_or_spec.time_precision_s
+            skew = builder_or_spec.tolerable_clock_skew_s
+            expiry = builder_or_spec.report_expiry_age_s
+        client = Client(
+            ClientParameters(task_id, topo.leader_url, topo.helper_url,
+                             Duration(precision)), factory())
+        client._ensure_configs()  # fetch HPKE configs once, pre-fan-out:
+        # prepare_report is then session-free and worker-thread safe
+        workloads.append(TaskWorkload(
+            name=f"{vdaf_name}-{i}",
+            client=client,
+            upload=HttpUploader(topo.leader_url, task_id),
+            measure=measure,
+            time_precision_s=precision,
+            tolerable_clock_skew_s=skew,
+            report_expiry_age_s=expiry,
+        ))
+    return workloads
+
+
+def warm_engines(task_defs, job_size: int, log) -> None:
+    """Compile each VDAF's prepare kernels before the load window opens.
+
+    The per-(VDAF, bucket) executables take minutes to build on a cold
+    CPU backend (and the persistent XLA cache is deliberately off there —
+    see janus_tpu.enable_compilation_cache); paying that inside the load
+    window stalls the drain and poisons every latency percentile.  One
+    synthetic full-bucket prepare round per VDAF — leader init, helper
+    init, leader finish, aggregate — through the SAME process-global
+    engines the job drivers use (models.vdaf_instance.prep_engine
+    memoizes per instance) moves the entire compile cost up front.
+    Compiles release the GIL, so the VDAFs warm in parallel."""
+    import random
+    import secrets
+    from concurrent.futures import ThreadPoolExecutor
+
+    from janus_tpu.engine.batch import bucket_size
+    from janus_tpu.models.vdaf_instance import dispatch
+
+    n = bucket_size(max(1, job_size))
+    jobs, seen = [], set()
+    for vdaf_name, (factory, _shape, measure) in task_defs:
+        if vdaf_name not in seen:
+            seen.add(vdaf_name)
+            jobs.append((vdaf_name, factory(), measure))
+
+    def _warm(name, inst, measure):
+        t0 = time.monotonic()
+        try:
+            vdaf, eng = dispatch(inst)
+            rng = random.Random(4242)
+            vk = secrets.token_bytes(vdaf.VERIFY_KEY_SIZE)
+            nonces, pubs, lshares, hshares = [], [], [], []
+            for _ in range(n):
+                nonce = secrets.token_bytes(16)
+                pub, shares = vdaf.shard(
+                    measure(rng), nonce, secrets.token_bytes(vdaf.RAND_SIZE))
+                nonces.append(nonce)
+                pubs.append(vdaf.encode_public_share(pub))
+                lshares.append(vdaf.encode_input_share(0, shares[0]))
+                hshares.append(vdaf.encode_input_share(1, shares[1]))
+            lead = eng.leader_init_batch(vk, nonces, pubs, lshares)
+            helped = eng.helper_init_batch(
+                vk, nonces, pubs, hshares, [r.outbound for r in lead])
+            done = eng.leader_finish(lead, [r.outbound for r in helped])
+            eng.aggregate(done)
+            bad = sum(1 for r in done if r.status != "finished")
+            log(f"warm {name}: bucket-{n} kernels ready in "
+                f"{time.monotonic() - t0:.1f}s"
+                + (f" ({bad} synthetic reports failed verify)" if bad else ""))
+        except Exception as e:  # a warm failure only costs compile latency
+            log(f"warm {name} FAILED after {time.monotonic() - t0:.1f}s: "
+                f"{type(e).__name__}: {e}")
+
+    with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+        list(pool.map(lambda j: _warm(*j), jobs))
+
+
+def top_up_to_job_multiple(workloads, scraper, job_size: int, log) -> int:
+    """Round every task's stored-report count up to a job-size multiple
+    with clean filler uploads, so pinned-size job creation can consume
+    the tail (the creator never forms a job below min_aggregation_job_size
+    and the drain would otherwise wait forever)."""
+    import random
+
+    scraper.tick()
+    merged = scraper.merged_funnel()
+    total = 0
+    for w in workloads:
+        tid = str(w.upload.task_id)
+        stored = merged.get(tid, {}).get("leader", {}).get(
+            "stages", {}).get("stored", 0)
+        if stored == 0 and tid not in merged:
+            log(f"top-up: task {w.name} missing from funnel; skipping")
+            continue
+        need = (-stored) % job_size
+        rng = random.Random(0xF1D0 + stored)
+        sent = 0
+        for _ in range(need):
+            try:
+                w.upload(w.client.prepare_report(w.measure(rng)).encode())
+                sent += 1
+            except Exception as e:
+                log(f"top-up upload failed for {w.name}: {e}")
+                break
+        total += sent
+    return total
+
+
+def wait_for_drain(scraper, timeout_s: float, log) -> bool:
+    """Poll the joined leader ledger until everything validated is
+    stored and everything stored finished preparation."""
+    from janus_tpu import funnel
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        scraper.tick()
+        agg = funnel.aggregate(scraper.merged_funnel())["roles"].get(
+            "leader", {})
+        st = agg.get("stages", {})
+        in_store = sum(agg.get("rejected", {}).get(r, 0)
+                       for r in funnel.IN_STORE_REJECTS)
+        if (st.get("validated", 0) - in_store == st.get("stored", 0)
+                and st.get("stored", 0) == st.get("agg_init", 0)
+                == st.get("prepare_done", 0) and st.get("stored", 0) > 0):
+            return True
+        time.sleep(1.0)
+    log("drain timeout: pipeline still has in-flight work")
+    return False
+
+
+def run_collections(args, topo, task_defs, run_start_s: float,
+                    run_end_s: float, log) -> list:
+    from janus_tpu.collector import Collector
+    from janus_tpu.messages import Duration, Interval, Query, TaskId, Time
+
+    results = []
+    for (vdaf_name, (factory, _shape, _measure)), (name2, b) in zip(
+            task_defs, topo.builders):
+        if args.mode == "inprocess":
+            task_id, precision = b.task_id, b.time_precision.seconds
+        else:
+            task_id, precision = TaskId(b.task_id), b.time_precision_s
+        token, keypair = topo.collector_credentials(b)
+        start = int(run_start_s) - int(run_start_s) % precision
+        end = (int(run_end_s) + 2 * precision)
+        end -= end % precision
+        query = Query.time_interval(Interval(Time(start),
+                                             Duration(end - start)))
+        entry = {"task": f"{vdaf_name}", "ok": False, "report_count": 0}
+        try:
+            collector = Collector(task_id, topo.leader_url, token, keypair,
+                                  factory())
+            job_id = collector.start_collection(query)
+            result = collector.poll_until_complete(
+                job_id, query, timeout_s=args.drain_timeout,
+                poll_interval_s=0.5)
+            entry["ok"] = True
+            entry["report_count"] = result.report_count
+        except Exception as e:
+            entry["error"] = f"{type(e).__name__}: {e}"
+            log(f"collection failed for {vdaf_name}: {e}")
+        results.append(entry)
+    return results
+
+
+# -- the run ----------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    import janus_tpu
+
+    # Persistent XLA compile cache, same reason as bench.py: the first
+    # aggregation batch must not pay a minutes-long compile mid-soak.
+    janus_tpu.enable_compilation_cache()
+    from janus_tpu.loadgen.artifact import (
+        build_artifact, next_artifact_path, write_artifact,
+    )
+    from janus_tpu.loadgen.audit import funnel_conservation_audit
+    from janus_tpu.loadgen.faults import FaultMix
+    from janus_tpu.loadgen.generator import LoadConfig, LoadGenerator
+    from janus_tpu.loadgen.scraper import Scraper
+
+    def log(msg):
+        print(f"[soak +{time.monotonic() - t_wall0:7.1f}s] {msg}",
+              flush=True)
+
+    t_wall0 = time.monotonic()
+    matrix = _vdaf_matrix()
+    vdaf_names = [v.strip() for v in args.vdafs.split(",") if v.strip()]
+    unknown = [v for v in vdaf_names if v not in matrix]
+    if unknown:
+        raise SystemExit(f"unknown vdafs {unknown} (matrix: "
+                         f"{sorted(matrix)})")
+    task_defs = [(vdaf_names[i % len(vdaf_names)],
+                  matrix[vdaf_names[i % len(vdaf_names)]])
+                 for i in range(args.tasks)]
+
+    mix = FaultMix.parse(args.bad_mix) if args.bad_mix else FaultMix()
+    config = LoadConfig(
+        duration_s=args.duration, rate_rps=args.rate,
+        schedule=args.schedule, fault_fraction=args.bad_fraction,
+        fault_mix=mix, fault_window=_fault_window(args.fault_window),
+        workers=args.workers, seed=args.seed)
+    scrape_interval = args.scrape_interval or min(
+        5.0, max(0.5, args.duration / 60))
+
+    log(f"mode={args.mode} duration={args.duration}s rate={args.rate}rps "
+        f"schedule={args.schedule} tasks={len(task_defs)} "
+        f"bad={args.bad_fraction} window={args.fault_window} "
+        f"scrape={scrape_interval}s")
+    topo = (InProcessTopology(args, task_defs) if args.mode == "inprocess"
+            else ComposeTopology(args, task_defs))
+    rc = 1
+    try:
+        workloads = build_workloads(args, topo, task_defs)
+        if args.mode == "inprocess" and not args.no_warm:
+            warm_engines(task_defs, args.job_size or 100, log)
+        generator = LoadGenerator(config, workloads)
+        scraper = Scraper(topo.health_services, interval_s=scrape_interval)
+        scraper.start()
+        run_start = time.time()
+        log("load generation started")
+        generator.run()
+        run_end = time.time()
+        summary = generator.summary()
+        log(f"load done: {summary['accepted']}/{summary['offered']} accepted "
+            f"({summary['sustained_accepted_rps']} rps sustained), "
+            f"injected={summary['injected_faults']}")
+
+        topo.flush_uploads()
+        fillers = 0
+        if args.job_size:
+            fillers = top_up_to_job_multiple(workloads, scraper,
+                                             args.job_size, log)
+            if fillers:
+                log(f"top-up: {fillers} filler reports to align tasks to "
+                    f"job size {args.job_size}")
+                topo.flush_uploads()
+        drained = wait_for_drain(scraper, args.drain_timeout, log)
+        collections = run_collections(args, topo, task_defs, run_start,
+                                      run_end, log)
+        # let the post-fault tail show the alert clearing before the
+        # final scrape (cheap: scraper keeps polling meanwhile)
+        scraper.stop(final_tick=True)
+        log(f"scraped {scraper.scrapes}x, errors={scraper.errors or 'none'}")
+
+        uploaded_expected = fillers + sum(
+            1 for o in generator.outcomes
+            if o.status == "accepted" or o.status.startswith("rejected:"))
+        audit = funnel_conservation_audit(
+            scraper.funnel_last.values(), final=True,
+            uploaded_expected=uploaded_expected)
+        if not drained:
+            audit["violations"].append("pipeline never drained (timeout)")
+            audit["ok"] = False
+
+        artifact = build_artifact(
+            config={
+                "mode": args.mode, "duration_s": args.duration,
+                "rate_rps": args.rate, "schedule": args.schedule,
+                "tasks": [f"{n}" for n, _ in task_defs],
+                "bad_fraction": args.bad_fraction,
+                "bad_mix": args.bad_mix or "default",
+                "fault_window": args.fault_window,
+                "scrape_interval_s": scrape_interval,
+                "seed": args.seed, "workers": args.workers,
+                "job_size": args.job_size, "top_up_reports": fillers,
+            },
+            generator=generator, scraper=scraper, audit=audit,
+            acceptance_objective=float(os.environ.get(
+                "JANUS_SLO_UPLOAD_ACCEPTANCE", "0.99")),
+            burn_alert=args.burn_alert,
+            collections=collections,
+            wall_s=time.monotonic() - t_wall0)
+        out = args.out or next_artifact_path(REPO)
+        write_artifact(artifact, out)
+
+        alerts = artifact["slo"]["alerts"].get("upload_acceptance", {})
+        log(f"artifact: {out}")
+        log(f"upload_acceptance: max fast burn "
+            f"{alerts.get('max_fast_burn')}, fired={alerts.get('fired')} "
+            f"cleared={alerts.get('cleared')}")
+        ok_collections = all(c["ok"] for c in collections)
+        if audit["ok"] and ok_collections:
+            log("conservation audit PASSED")
+            rc = 0
+        else:
+            for v in audit["violations"]:
+                log(f"VIOLATION: {v}")
+            if not ok_collections:
+                log("one or more collections failed")
+            log("conservation audit FAILED")
+            rc = 1
+        for a in audit["anomalies"]:
+            log(f"anomaly: {a}")
+    finally:
+        topo.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

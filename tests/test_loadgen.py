@@ -1,0 +1,354 @@
+"""Unit tests for the soak load-generation package (janus_tpu.loadgen)
+and the funnel/metrics plumbing it rides on: arrival schedules, fault
+mutation, the label-cardinality cap, cross-service ledger merge +
+conservation audit, exposition histogram parsing, artifact assembly,
+and the bench-diff artifact gate."""
+
+import json
+import random
+
+import pytest
+
+from janus_tpu import funnel, metrics
+from janus_tpu.loadgen.artifact import percentiles
+from janus_tpu.loadgen.faults import (
+    ACCEPTANCE_BURNING,
+    FAULT_KINDS,
+    FaultInjector,
+    FaultMix,
+)
+from janus_tpu.loadgen.scraper import parse_histogram
+from janus_tpu.loadgen.schedule import (
+    DiurnalSchedule,
+    PoissonSchedule,
+    make_schedule,
+)
+
+
+# -- schedules -------------------------------------------------------------
+
+
+def test_poisson_schedule_rate_and_determinism():
+    sched = PoissonSchedule(100.0)
+    a1 = list(sched.arrivals(10.0, random.Random(7)))
+    a2 = list(sched.arrivals(10.0, random.Random(7)))
+    assert a1 == a2  # deterministic under the seed
+    assert all(0 <= t < 10.0 for t in a1)
+    assert a1 == sorted(a1)
+    # ~1000 arrivals; Poisson sd ~32, allow 5 sigma
+    assert 840 <= len(a1) <= 1160
+
+
+def test_diurnal_schedule_ramps():
+    sched = DiurnalSchedule(10.0, 100.0)
+    arrivals = list(sched.arrivals(60.0, random.Random(3)))
+    first_half = sum(1 for t in arrivals if t < 20.0)
+    mid = sum(1 for t in arrivals if 20.0 <= t < 40.0)
+    # the sinusoid peaks mid-run: the middle third must dominate
+    assert mid > first_half * 1.5
+    assert sched.rate_at(0.0, 60.0) == pytest.approx(10.0)
+    assert sched.rate_at(30.0, 60.0) == pytest.approx(100.0)
+
+
+def test_make_schedule_factory():
+    assert make_schedule("poisson", 50.0).peak_rate() == 50.0
+    d = make_schedule("diurnal", 80.0)
+    assert isinstance(d, DiurnalSchedule)
+    assert d.peak_rate() == 80.0
+    with pytest.raises(ValueError):
+        make_schedule("square-wave", 1.0)
+
+
+# -- faults ----------------------------------------------------------------
+
+
+def test_fault_mix_parse_and_pick():
+    mix = FaultMix.parse("malformed=1")
+    assert mix.pick(random.Random(1)) == "malformed"
+    mix = FaultMix.parse("replayed=0.5,expired=0.5")
+    kinds = {mix.pick(random.Random(i)) for i in range(50)}
+    assert kinds == {"replayed", "expired"}
+    with pytest.raises(ValueError):
+        FaultMix.parse("gamma_ray=1")
+    with pytest.raises(ValueError):
+        FaultMix.parse("malformed=0")
+
+
+def test_fault_injector_window_and_fraction():
+    inj = FaultInjector(1.0, FaultMix(), random.Random(5),
+                        window=(0.2, 0.6))
+    assert inj.decide(0.1) is None
+    assert inj.decide(0.7) is None
+    assert inj.decide(0.3) in FAULT_KINDS
+    none_inj = FaultInjector(0.0, FaultMix(), random.Random(5))
+    assert all(none_inj.decide(p / 10) is None for p in range(10))
+    # acceptance-burning kinds are exactly the pre-store rejects
+    assert set(ACCEPTANCE_BURNING) == set(FAULT_KINDS) - {"replayed"}
+
+
+def test_tamper_leader_ciphertext_keeps_report_decodable():
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.core.hpke import HpkeKeypair
+    from janus_tpu.loadgen.faults import tamper_leader_ciphertext
+    from janus_tpu.messages import Duration, Report, TaskId
+    from janus_tpu.models import VdafInstance
+
+    leader_kp, helper_kp = HpkeKeypair.generate(1), HpkeKeypair.generate(2)
+    client = Client(
+        ClientParameters(TaskId(b"\x01" * 32), "http://l", "http://h",
+                         Duration(3600)),
+        VdafInstance.prio3_count(),
+        leader_hpke_config=leader_kp.config,
+        helper_hpke_config=helper_kp.config)
+    report = client.prepare_report(1)
+    bad = tamper_leader_ciphertext(report)
+    # wire-decodable (the funnel must still count it `uploaded`) ...
+    rt = Report.decode(bad.encode())
+    assert rt.metadata == report.metadata
+    # ... only the leader share changed, and only in its payload
+    assert bad.helper_encrypted_input_share == \
+        report.helper_encrypted_input_share
+    assert bad.leader_encrypted_input_share.payload != \
+        report.leader_encrypted_input_share.payload
+    assert bad.leader_encrypted_input_share.encapsulated_key == \
+        report.leader_encrypted_input_share.encapsulated_key
+
+
+# -- funnel: cardinality cap, reset, merge, conservation -------------------
+
+
+def test_funnel_task_cap_overflows_to_other(monkeypatch):
+    funnel.clear()
+    monkeypatch.setenv("JANUS_FUNNEL_MAX_TASKS", "3")
+    try:
+        for i in range(10):
+            funnel.count("uploaded", f"task-{i}")
+        snap = funnel.snapshot()
+        assert set(snap) == {"task-0", "task-1", "task-2",
+                             funnel.OTHER_TASKS_LABEL}
+        # overflow tasks share one bucket and still conserve
+        assert snap[funnel.OTHER_TASKS_LABEL]["leader"]["stages"][
+            "uploaded"] == 7
+        # an admitted task keeps its own ledger for later counts
+        funnel.count("validated", "task-1")
+        assert funnel.snapshot()["task-1"]["leader"]["stages"][
+            "validated"] == 1
+        # the exposition stays bounded: cap + 1 task labels, no more
+        labels = {dict(k).get("task_id")
+                  for k, _ in funnel.reports_total.snapshot()}
+        assert len(labels) == 4
+    finally:
+        funnel.clear()
+
+
+def test_counter_reset_and_registry_reset_instrument():
+    c = metrics.REGISTRY.counter("test_reset_total", "t")
+    c.add(5, shard="a")
+    c.add(3, shard="b")
+    assert sum(v for _, v in c.snapshot()) == 8
+    c.reset()
+    assert list(c.snapshot()) == []
+    c.add(1, shard="a")
+    assert metrics.REGISTRY.reset_instrument("test_reset_total") is True
+    assert list(c.snapshot()) == []
+    assert metrics.REGISTRY.reset_instrument("no_such_metric") is False
+    h = metrics.REGISTRY.histogram("test_reset_seconds", "t", buckets=(1.0,))
+    h.observe(0.5)
+    h.reset()
+    assert list(h.snapshot()) == []
+
+
+def _ledger(stages, rejected=None):
+    return {"stages": dict(stages), "rejected": dict(rejected or {})}
+
+
+def test_merge_snapshots_joins_split_services():
+    # the leader's stages land in three different processes
+    upload_proc = {"t": {"leader": _ledger(
+        {"uploaded": 10, "validated": 9, "stored": 9},
+        {"decrypt_failure": 1})}}
+    agg_proc = {"t": {"leader": _ledger(
+        {"agg_init": 9, "prepare_done": 9})}}
+    coll_proc = {"t": {"leader": _ledger({"collected": 9})}}
+    merged = funnel.merge_snapshots([upload_proc, agg_proc, coll_proc])
+    stages = merged["t"]["leader"]["stages"]
+    assert stages == {"uploaded": 10, "validated": 9, "stored": 9,
+                      "agg_init": 9, "prepare_done": 9, "collected": 9}
+    assert merged["t"]["leader"]["rejected_total"] == 1
+    verdict = funnel.conservation(merged, final=True)
+    assert verdict["ok"], verdict["violations"]
+
+
+def test_conservation_flags_unexplained_loss():
+    # mid-run: positive residual is in-flight, tolerated
+    tasks = {"t": {"leader": _ledger({"uploaded": 10, "validated": 7},
+                                     {"expired": 1})}}
+    mid = funnel.conservation(tasks, final=False)
+    assert mid["ok"]
+    assert mid["per_task"]["t"]["leader"]["pending_validation"] == 2
+    # final: the same residual is unexplained loss
+    fin = funnel.conservation(tasks, final=True)
+    assert not fin["ok"]
+    assert "neither validated nor rejected" in fin["violations"][0]
+    # negative residual (phantom reports) is ALWAYS a violation
+    phantom = funnel.conservation(
+        {"t": {"leader": _ledger({"uploaded": 5, "validated": 6})}})
+    assert not phantom["ok"]
+
+
+def test_conservation_in_store_rejects_count_after_validated():
+    # a replayed report validates, then dedups in the store tx: it must
+    # NOT be double-counted against uploaded
+    tasks = {"t": {"leader": _ledger(
+        {"uploaded": 10, "validated": 10, "stored": 8,
+         "agg_init": 8, "prepare_done": 8},
+        {"duplicate": 2})}}
+    verdict = funnel.conservation(tasks, final=True)
+    assert verdict["ok"], verdict["violations"]
+    assert verdict["per_task"]["t"]["leader"]["pending_store"] == 0
+
+
+def test_conservation_final_checks_leader_helper_agreement():
+    tasks = {"t": {
+        "leader": _ledger({"uploaded": 5, "validated": 5, "stored": 5,
+                           "agg_init": 5, "prepare_done": 5}),
+        "helper": _ledger({"agg_init": 5, "prepare_done": 4}),
+    }}
+    fin = funnel.conservation(tasks, final=True)
+    assert not fin["ok"]
+    assert any("disagree" in v for v in fin["violations"])
+    assert funnel.conservation(tasks, final=False)["ok"]
+
+
+def test_funnel_aggregate_cross_task_totals():
+    funnel.clear()
+    try:
+        funnel.count("uploaded", "a", 4)
+        funnel.count("uploaded", "b", 6)
+        funnel.reject("b", "expired", 2)
+        funnel.count("agg_init", "a", 4, role="helper")
+        agg = funnel.aggregate()
+        assert agg["tasks"] == 2
+        assert agg["roles"]["leader"]["stages"]["uploaded"] == 10
+        assert agg["roles"]["leader"]["rejected"] == {"expired": 2}
+        assert agg["roles"]["helper"]["stages"]["agg_init"] == 4
+    finally:
+        funnel.clear()
+
+
+def test_debug_funnel_serves_aggregate_and_conservation():
+    import requests
+
+    from janus_tpu.health import HealthServer
+
+    funnel.clear()
+    try:
+        funnel.count("uploaded", "t9", 3)
+        funnel.count("validated", "t9", 3)
+        server = HealthServer(debug_console=True).start()
+        try:
+            body = requests.get(f"{server.address}/debug/funnel",
+                                timeout=5).json()
+            assert body["aggregate"]["roles"]["leader"]["stages"][
+                "uploaded"] == 3
+            assert body["conservation"]["ok"]
+            assert body["conservation"]["final"] is False
+            strict = requests.get(
+                f"{server.address}/debug/funnel?final=1", timeout=5).json()
+            assert strict["conservation"]["final"] is True
+            slo_body = requests.get(f"{server.address}/debug/slo",
+                                    timeout=5).json()
+            assert "funnel" in slo_body
+            assert slo_body["funnel"]["conservation"]["ok"]
+        finally:
+            server.stop()
+    finally:
+        funnel.clear()
+
+
+# -- scraper parsing -------------------------------------------------------
+
+
+def test_parse_histogram_sums_label_sets():
+    text = (
+        'demo_seconds_bucket{route="x",le="0.1"} 1\n'
+        'demo_seconds_bucket{route="x",le="1.0"} 1\n'
+        'demo_seconds_bucket{route="x",le="+Inf"} 2\n'
+        'demo_seconds_sum{route="x"} 2.05\n'
+        'demo_seconds_count{route="x"} 2\n'
+        'demo_seconds_bucket{route="y",le="0.1"} 0\n'
+        'demo_seconds_bucket{route="y",le="1.0"} 1\n'
+        'demo_seconds_bucket{route="y",le="+Inf"} 1\n'
+        'demo_seconds_sum{route="y"} 0.5\n'
+        'demo_seconds_count{route="y"} 1\n')
+    bounds, counts, total_sum, total_count = parse_histogram(
+        text, "demo_seconds")
+    assert bounds == [0.1, 1.0]
+    assert counts == [1, 1, 1]  # per-bucket, +Inf overflow last
+    assert total_sum == pytest.approx(2.55)
+    assert total_count == 3
+    assert parse_histogram(text, "absent_seconds") is None
+
+
+def test_percentiles_interpolation():
+    p = percentiles(list(range(1, 101)))
+    assert p["count"] == 100
+    assert p["p50"] == pytest.approx(50.5)
+    assert p["p99"] == pytest.approx(99.01)
+    assert p["p999"] > p["p99"]
+    assert percentiles([]) is None
+
+
+# -- bench-diff ------------------------------------------------------------
+
+
+def _soak_doc(rps, p99):
+    return {
+        "kind": "soak",
+        "throughput": {"sustained_accepted_rps": rps},
+        "latency": {"upload_s": {"p50": p99 / 2, "p99": p99,
+                                 "p999": p99 * 2, "count": 100}},
+        "slo": {"series": {"inproc": [
+            {"t": 1.0, "slos": {"upload_acceptance":
+                                {"budget_remaining": 0.8}}}]}},
+    }
+
+
+def test_bench_diff_detects_regression(tmp_path, capsys):
+    from janus_tpu.tools import main as tools_main
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_soak_doc(100.0, 0.010)))
+    # candidate: throughput down 40%, latency up 3x
+    b.write_text(json.dumps(_soak_doc(60.0, 0.030)))
+    rc = tools_main(["bench-diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSED" in out
+    # within threshold: ok
+    b.write_text(json.dumps(_soak_doc(95.0, 0.0105)))
+    assert tools_main(["bench-diff", str(a), str(b)]) == 0
+    # wildly improved still exits 0
+    b.write_text(json.dumps(_soak_doc(500.0, 0.001)))
+    assert tools_main(["bench-diff", str(a), str(b)]) == 0
+
+
+def test_bench_diff_reads_bench_wrapper_and_raw_lines(tmp_path):
+    from janus_tpu.tools import main as tools_main
+
+    record = {"metric": "x", "value": 1000.0, "unit": "r/s",
+              "detail": {"Prio3Count": {"reports_per_sec": 1000.0}}}
+    # driver wrapper shape (BENCH_rNN.json)
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps({"n": 1, "rc": 0, "parsed": record}))
+    # raw bench.py stdout shape: two JSON lines
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"detail": record["detail"]}) + "\n"
+                 + json.dumps({k: v for k, v in record.items()
+                               if k != "detail"}) + "\n")
+    assert tools_main(["bench-diff", str(a), str(b)]) == 0
+    # disjoint artifacts: no comparable metrics
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps({"kind": "soak", "throughput": {}}))
+    assert tools_main(["bench-diff", str(a), str(c)]) == 2

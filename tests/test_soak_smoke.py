@@ -1,0 +1,103 @@
+"""Tier-1 smoke soak: run the real soak driver as a subprocess for a few
+seconds against the in-process leader+helper pair and assert the artifact
+is well-formed, the funnel conserves, and the injected bad fraction is
+visible both in the reject ledger and in the upload_acceptance burn rate."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Fault kinds that reject before `validated` and therefore burn the
+# upload_acceptance SLI (replay dedups after validation, so it doesn't).
+_BURNING = {"malformed": "decrypt_failure",
+            "expired": "expired",
+            "clock_skewed": "too_early"}
+
+
+@pytest.fixture(scope="module")
+def soak_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("soak") / "SOAK_smoke.json"
+    cmd = [
+        sys.executable, str(REPO / "soak.py"),
+        "--mode", "inprocess",
+        "--duration", "6", "--rate", "25",
+        "--tasks", "2", "--vdafs", "count,count",
+        "--bad-fraction", "0.12",
+        "--bad-mix", "malformed=0.5,expired=0.25,clock_skewed=0.25",
+        "--fault-window", "0.0,0.7",
+        "--burn-alert", "1.5",
+        "--scrape-interval", "0.5",
+        "--drain-timeout", "300",
+        "--seed", "11",
+        "--out", str(out),
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=540,
+                          capture_output=True, text=True)
+    return proc, out
+
+
+def test_soak_exits_clean(soak_run):
+    proc, _ = soak_run
+    assert proc.returncode == 0, (
+        f"soak rc={proc.returncode}\n--- stdout ---\n{proc.stdout[-4000:]}"
+        f"\n--- stderr ---\n{proc.stderr[-4000:]}")
+
+
+def test_artifact_well_formed(soak_run):
+    _, out = soak_run
+    doc = json.loads(out.read_text())
+    assert doc["kind"] == "soak"
+    assert doc["schema"] == 1
+    for key in ("run", "throughput", "latency", "faults", "slo",
+                "funnel", "scrape", "environment"):
+        assert key in doc, f"artifact missing {key!r}"
+    assert doc["throughput"]["offered"] > 0
+    assert doc["throughput"]["accepted"] > 0
+    assert doc["throughput"]["sustained_accepted_rps"] > 0
+    up = doc["latency"]["upload_s"]
+    assert up and 0 < up["p50"] <= up["p99"] <= up["p999"]
+    assert doc["scrape"]["errors"] == {} or \
+        all(v == 0 for v in doc["scrape"]["errors"].values())
+
+
+def test_conservation_holds(soak_run):
+    _, out = soak_run
+    doc = json.loads(out.read_text())
+    audit = doc["funnel"]["conservation"]
+    assert audit["final"] is True
+    assert audit["ok"], audit["violations"]
+    agg = doc["funnel"]["aggregate"]["roles"]["leader"]
+    # everything stored made it all the way through preparation
+    assert agg["stages"]["stored"] == agg["stages"]["prepare_done"]
+    assert agg["stages"]["stored"] > 0
+
+
+def test_bad_fraction_visible_in_rejects_and_burn(soak_run):
+    _, out = soak_run
+    doc = json.loads(out.read_text())
+    faults = doc["faults"]
+    injected = faults["injected"]
+    assert sum(injected.values()) > 0
+    assert faults["actual_bad_fraction"] > 0
+
+    # every acceptance-burning fault kind that was injected shows up in
+    # the leader reject ledger under its mapped reason, with full count
+    rejected = doc["funnel"]["aggregate"]["roles"]["leader"]["rejected"]
+    for kind, reason in _BURNING.items():
+        if injected.get(kind):
+            assert rejected.get(reason, 0) >= injected[kind], (
+                f"{kind}: injected {injected[kind]}, "
+                f"ledger has {reason}={rejected.get(reason, 0)}")
+
+    # ...and the upload_acceptance SLI burned while faults flowed
+    alerts = doc["slo"]["alerts"]
+    acc = alerts.get("upload_acceptance")
+    assert acc is not None, f"no upload_acceptance series: {list(alerts)}"
+    assert acc["max_fast_burn"] > 0
