@@ -631,9 +631,9 @@ def _backend_platform() -> str:
 # from deep inside jax (BENCH_r05: a convert_element_type minutes in,
 # previous four rounds green).  Section-level try/excepts would record it
 # as a per-config error and exit 1; instead ANY backend-unavailable error
-# anywhere restarts the whole bench pinned to CPU.
-_BACKEND_ERR_MARKERS = ("Unable to initialize backend",
-                        "backend setup/compile error")
+# anywhere restarts the whole bench pinned to CPU.  The marker list lives
+# with the serving-side breaker so the two classifiers cannot drift.
+from janus_tpu.engine.resilient import _BACKEND_ERR_MARKERS  # noqa: E402
 
 
 def _cpu_fallback_if_backend_error(e: BaseException) -> None:

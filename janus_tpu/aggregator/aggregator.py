@@ -908,12 +908,22 @@ class Aggregator:
 
                     fused = fused_for(engine)
                     if fused is not None:
-                        launch = fused.run(
-                            kp, hpke.application_info(
-                                hpke.Label.INPUT_SHARE, Role.CLIENT,
-                                Role.HELPER),
-                            task.vdaf_verify_key, bytes(task_id), body,
-                            table)
+                        try:
+                            launch = fused.run(
+                                kp, hpke.application_info(
+                                    hpke.Label.INPUT_SHARE, Role.CLIENT,
+                                    Role.HELPER),
+                                task.vdaf_verify_key, bytes(task_id), body,
+                                table)
+                        except Exception as e:
+                            # backend lost mid-dispatch: demote the engine
+                            # (breaker opens) and serve this request via
+                            # the phase-structured path, now oracle-routed
+                            if not getattr(engine, "note_backend_failure",
+                                           lambda *_a, **_k: False)(
+                                    e, where="fused_init.run"):
+                                raise
+                            launch = None
 
         tl = table.tolist()
         ids = [body[r[0]:r[0] + 16] for r in tl]
@@ -931,6 +941,14 @@ class Aggregator:
                     _mark, t_phase)
             except _FusedAnomalous:
                 pass  # nothing persisted: redo via the phases below
+            except Exception as e:
+                # launch.fetch() observing the backend loss lands here;
+                # nothing persisted yet, so demote and redo via the
+                # phases below (which now route through the host oracle)
+                if not getattr(engine, "note_backend_failure",
+                               lambda *_a, **_k: False)(
+                        e, where="fused_init.fetch"):
+                    raise
 
         # Phase 1a: HPKE open, grouped by config id (cols: 4=config_id,
         # 5/6=enc off/len, 7/8=ct off/len, 2/3=pub off/len).

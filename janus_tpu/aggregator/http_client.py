@@ -17,6 +17,37 @@ class PeerHttpError(Exception):
         self.body = body
 
 
+def _classify_unreachable(e: BaseException) -> str:
+    """Connection-layer cause for janus_helper_unreachable_total: these
+    attempts never produced an HTTP status, so they are a helper OUTAGE
+    signal — disjoint from retryable 5xx (helper up but erroring) and
+    from slow-RTT burn (helper up but slow)."""
+    try:
+        import requests.exceptions as rex
+
+        if isinstance(e, rex.Timeout):
+            return "timeout"
+        if isinstance(e, rex.ConnectionError):
+            root = e
+            while root.__cause__ is not None or root.__context__ is not None:
+                root = root.__cause__ or root.__context__
+                if isinstance(root, ConnectionRefusedError):
+                    return "refused"
+            return "connect"
+    except ImportError:  # pragma: no cover - requests always present
+        pass
+    if isinstance(e, ConnectionRefusedError):
+        return "refused"
+    if isinstance(e, TimeoutError):
+        return "timeout"
+    return "connect"
+
+
+def _count_unreachable(method: str, e: BaseException) -> None:
+    metrics.helper_unreachable_total.add(
+        1, method=method, cause=_classify_unreachable(e))
+
+
 class PeerClient:
     def __init__(self, session=None, backoff: Backoff | None = None,
                  timeout: float = 180.0):
@@ -47,9 +78,11 @@ class PeerClient:
                 resp = self.session.request(method, url, data=body,
                                             headers=headers,
                                             timeout=self.timeout)
-            except OSError:
+            except OSError as e:
+                _count_unreachable(method, e)
                 raise
             except Exception as e:  # requests wraps connection errors
+                _count_unreachable(method, e)
                 raise OSError(str(e)) from e
             return HttpResult(resp.status_code, dict(resp.headers), resp.content)
 

@@ -52,14 +52,38 @@ def build_datastore(common, datastore_keys: list[str] | None) -> Datastore:
                    max_transaction_retries=common.max_transaction_retries)
     try:
         ds.check_schema_version()
-    except Exception:
+    except Exception as check_err:
+        trace.warn("schema version check failed; attempting migration",
+                   error=str(check_err) or repr(check_err))
         try:
             ds.migrate()  # older on-disk schema: apply incremental migrations
             ds.check_schema_version()
-        except Exception:
+        except Exception as migrate_err:
+            if _schema_table_present(ds):
+                # the schema-version table EXISTS but can't be read or
+                # migrated: a real datastore fault.  Re-creating the schema
+                # here would mask it as "fresh database" — refuse.
+                trace.error("schema migration failed on an existing database",
+                            error=str(migrate_err) or repr(migrate_err))
+                raise
+            trace.warn("schema_version table absent; installing fresh schema",
+                       migrate_error=str(migrate_err) or repr(migrate_err))
             ds.put_schema()  # fresh database
     ds.check_schema_version()
     return ds
+
+
+def _schema_table_present(ds: Datastore) -> bool:
+    """Does the schema_version table exist at all?  Distinguishes a fresh
+    database (put_schema is safe) from a corrupt/locked one (it isn't)."""
+    conn = ds.backend.connect()
+    try:
+        conn.execute("SELECT 1 FROM schema_version LIMIT 1").fetchone()
+        return True
+    except Exception:
+        return False
+    finally:
+        conn.close()
 
 
 def _probe_accelerator() -> None:
@@ -72,11 +96,23 @@ def _probe_accelerator() -> None:
     engine modules build device constants at import) and 500 every request.
     A service on the CPU path stays fully correct — the kernels are
     platform-agnostic — just slower.
+
+    The probe runs under a watchdog thread (JANUS_BACKEND_PROBE_TIMEOUT,
+    default 90 s): a BLACK-HOLED accelerator tunnel makes jax.devices()
+    hang forever rather than raise, which would deadlock the service at
+    startup.  A timeout demotes to CPU exactly like an init failure.
     """
     import jax
 
+    from janus_tpu.engine import resilient
+
+    timeout_s = 90.0
     try:
-        dev = jax.devices()[0]
+        timeout_s = float(os.environ["JANUS_BACKEND_PROBE_TIMEOUT"])
+    except (KeyError, ValueError):
+        pass
+    try:
+        dev = resilient.probe_backend(timeout_s)[0]
         trace.info("accelerator initialized", platform=dev.platform)
     except Exception as e:
         reason = str(e).splitlines()[0] if str(e) else repr(e)
@@ -85,7 +121,10 @@ def _probe_accelerator() -> None:
             from jax.extend.backend import clear_backends
 
             clear_backends()
-            jax.devices()
+            # also watchdogged: a probe thread still hung inside backend
+            # init can hold jax's global backend lock, which would turn
+            # this fallback into the same deadlock
+            resilient.probe_backend(timeout_s)
         except Exception as e2:  # pragma: no cover - no backend at all
             trace.error("no usable JAX backend",
                         error=str(e2) or repr(e2))
@@ -114,8 +153,12 @@ def janus_main(argv, config_cls, run):
         hhost, hport = _parse_addr(cfg.common.health_check_listen_address)
         try:
             health = HealthServer(hhost, hport).start()
-        except OSError:
-            health = None  # port in use: health listener is best-effort
+        except OSError as e:
+            # best-effort, but never silently: an operator probing a dark
+            # /healthz needs to know the listener lost its port
+            health = None
+            trace.warn("health listener failed to bind; /healthz disabled",
+                       address=hhost, port=hport, error=str(e) or repr(e))
     stop = threading.Event()
 
     def _sig(_signo, _frame):

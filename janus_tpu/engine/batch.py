@@ -310,12 +310,20 @@ class BatchPrio3:
         transfers overlap the previous chunk's kernel.  Returns
         (device_arrays, upload_seconds)."""
         t0 = time.monotonic()
-        staged = tuple(jax.device_put(a) for a in arrays)
-        if not timed:
-            return staged, 0.0
-        for d in staged:
-            # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: the blocking wait IS the link-bandwidth observation that feeds LINK.record_up
-            d.block_until_ready()
+        try:
+            staged = tuple(jax.device_put(a) for a in arrays)
+            if not timed:
+                return staged, 0.0
+            for d in staged:
+                # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: the blocking wait IS the link-bandwidth observation that feeds LINK.record_up
+                d.block_until_ready()
+        except Exception as e:
+            # a lost backend surfaces here as the staging error; re-typed
+            # so ResilientEngine demotes and re-serves via the oracle
+            from janus_tpu.engine import resilient
+
+            resilient.raise_if_backend_error(e)
+            raise
         dt = time.monotonic() - t0
         streaming.LINK.record_up(sum(a.nbytes for a in arrays), dt)
         return staged, dt
@@ -326,11 +334,17 @@ class BatchPrio3:
         phase), then time the pure fetch and feed the link estimator.
         Returns (host_arrays, compute_wait_s, fetch_s)."""
         t0 = time.monotonic()
-        for d in device_arrays:
-            # janus-lint: disable=hot-path-sync -- deliberate split-fetch boundary: block on compute first so the timed np.asarray below measures pure downlink for LINK.record_down
-            d.block_until_ready()
-        t1 = time.monotonic()
-        out = tuple(np.asarray(d) for d in device_arrays)
+        try:
+            for d in device_arrays:
+                # janus-lint: disable=hot-path-sync -- deliberate split-fetch boundary: block on compute first so the timed np.asarray below measures pure downlink for LINK.record_down
+                d.block_until_ready()
+            t1 = time.monotonic()
+            out = tuple(np.asarray(d) for d in device_arrays)
+        except Exception as e:
+            from janus_tpu.engine import resilient
+
+            resilient.raise_if_backend_error(e)
+            raise
         t2 = time.monotonic()
         streaming.LINK.record_down(sum(a.nbytes for a in out), t2 - t1)
         return out, t1 - t0, t2 - t1

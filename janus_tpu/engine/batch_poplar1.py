@@ -275,10 +275,18 @@ class BatchPoplar1(HostPrepEngine):
         vk_rows = np.broadcast_to(
             np.frombuffer(verify_key, dtype=np.uint8),
             (N, len(verify_key)))
-        ys_d, abc_d, r1_d, rej_d = fn(vk_rows, fixed, seeds, cw_seeds,
-                                      cw_ctrls, payload, corr_seeds, offs,
-                                      nonce_rows, prefix_bits)
-        rej = np.asarray(rej_d)
+        try:
+            ys_d, abc_d, r1_d, rej_d = fn(vk_rows, fixed, seeds, cw_seeds,
+                                          cw_ctrls, payload, corr_seeds,
+                                          offs, nonce_rows, prefix_bits)
+            rej = np.asarray(rej_d)
+        except Exception as e:
+            # lost-backend dispatch/materialize failure: re-typed so
+            # ResilientEngine demotes and re-serves via the host oracle
+            from janus_tpu.engine import resilient
+
+            resilient.raise_if_backend_error(e)
+            raise
 
         def to_ints(arr_d) -> np.ndarray:
             """Vectorized limb fold: [L, ...] u32 -> object array of ints
@@ -472,10 +480,16 @@ class BatchPoplar1(HostPrepEngine):
             cold = ("hfast", N, P, level) not in self._fns
             fn = self._helper_fast_fn(N, P, level)
             t_pack = time.perf_counter()
-            bundle = np.asarray(fn(
-                vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
-                corr_seeds, nonce_rows, pb,
-                np.ascontiguousarray(lr1.transpose(2, 1, 0))))
+            try:
+                bundle = np.asarray(fn(
+                    vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
+                    corr_seeds, nonce_rows, pb,
+                    np.ascontiguousarray(lr1.transpose(2, 1, 0))))
+            except Exception as e:
+                from janus_tpu.engine import resilient
+
+                resilient.raise_if_backend_error(e)
+                raise
             t_dev = time.perf_counter()
             flags = bundle[0, 7, :k]
 

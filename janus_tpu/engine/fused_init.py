@@ -66,12 +66,18 @@ class FusedLaunch:
         Returns msg_seeds [N, ss] u8 plus per-lane bool arrays: ok_hpke,
         pt_ok, msg_ok, range_ok, proof_ok, jr_ok, fallback."""
         if self._res is None:
-            from janus_tpu.engine import streaming
+            from janus_tpu.engine import resilient, streaming
 
-            # janus-lint: disable=hot-path-sync -- deliberate split-fetch boundary: block on compute first so the timed np.asarray below measures pure downlink for LINK.record_down
-            self._out_d.block_until_ready()
-            t_fetch = time.perf_counter()
-            full = np.asarray(self._out_d)
+            try:
+                # janus-lint: disable=hot-path-sync -- deliberate split-fetch boundary: block on compute first so the timed np.asarray below measures pure downlink for LINK.record_down
+                self._out_d.block_until_ready()
+                t_fetch = time.perf_counter()
+                full = np.asarray(self._out_d)
+            except Exception as e:
+                # a mid-run backend loss surfaces here as the materialize
+                # error; re-typed so the call site can demote the engine
+                resilient.raise_if_backend_error(e)
+                raise
             t_done = time.perf_counter()
             streaming.LINK.record_down(full.nbytes, t_done - t_fetch)
             out = full[: self.n]
@@ -316,6 +322,17 @@ class FusedHelperInit:
             cold = (M, cl, pl, ml) not in self._fns
         fn = self._fn(M, cl, pl, ml)
         t_pack = time.perf_counter()
+        from janus_tpu.engine import resilient
+
+        try:
+            return self._dispatch(e, fn, const_row, lanes, n, ss, M, cold,
+                                  t_begin, t_pack)
+        except Exception as err:
+            resilient.raise_if_backend_error(err)
+            raise
+
+    def _dispatch(self, e, fn, const_row, lanes, n, ss, M, cold,
+                  t_begin, t_pack) -> FusedLaunch:
         t_up = 0.0
         if getattr(e, "streaming", False):
             # explicit timed staging (streaming data plane): the upload
@@ -347,11 +364,18 @@ _attach_lock = threading.Lock()
 
 
 def fused_for(engine) -> FusedHelperInit | None:
-    """Lazily attach a FusedHelperInit to a BatchPrio3 engine (or the inner
-    engine of a coalescing wrapper); None when the engine can't fuse.
-    Locked check-then-set: concurrent first requests must share ONE
-    instance, or each would jit-compile its own copy of the kernel."""
-    inner = getattr(engine, "inner", engine)
+    """Lazily attach a FusedHelperInit to a BatchPrio3 engine (or the
+    innermost engine of wrapper stacks — resilient/coalescing); None when
+    the engine can't fuse.  Locked check-then-set: concurrent first
+    requests must share ONE instance, or each would jit-compile its own
+    copy of the kernel."""
+    if not getattr(engine, "device_ok", True):
+        # a demoted ResilientEngine serves via the host oracle; its inner
+        # BatchPrio3 would still claim device_ok, so gate on the wrapper
+        return None
+    inner = engine
+    while hasattr(inner, "inner"):
+        inner = inner.inner
     if not hasattr(inner, "_helper_fn"):  # not a BatchPrio3
         return None
     with _attach_lock:

@@ -1,0 +1,617 @@
+"""Runtime backend-loss circuit breaker around the device prepare engines.
+
+The tunneled TPU in this deployment can drop MID-RUN: the next eager op
+then raises "Unable to initialize backend ..." from deep inside jax
+(bench.py saw it minutes into a green run, BENCH_r05).  bench.py answers
+by re-exec'ing the whole process on CPU; a serving aggregator cannot —
+it holds leases, sockets and an upload pipeline.  This module gives the
+service plane the serving-shaped answer:
+
+  * ``ResilientEngine`` wraps the device engine installed by
+    ``models.vdaf_instance.prep_engine`` (CoalescingEngine(BatchPrio3) /
+    BatchPoplar1).  Every prepare/aggregate entry point is guarded: on a
+    classified device-backend failure the breaker OPENS and the call is
+    re-served through the bit-identical ``HostPrepEngine`` oracle — the
+    request that observed the failure still completes, so the funnel
+    loses nothing.
+  * While open, all traffic routes to the oracle and a background probe
+    thread re-checks the backend with exponential backoff
+    (core.retries.Backoff).  When the probe passes the breaker CLOSES
+    and traffic returns to the device path, reusing the inner engine's
+    cached compiled executables (they are never cleared).
+  * Demotion emits a ``watchdog_stall`` flight-recorder event, bumps
+    ``janus_engine_demotions_total`` and flips the
+    ``janus_engine_state{kind,state}`` gauge; ``engines_snapshot()``
+    feeds the /debug/watchdog verdict.  Per-path report counters
+    (``janus_engine_calls_total``) drive the ``device_availability``
+    SLI in janus_tpu.slo.
+
+Classification (``is_backend_error``) uses the same marker strings
+bench.py derived from production traces — bench.py now imports them from
+here so the two lists cannot drift.  Device-resident state (HBM LaneRefs
+staged before the loss) is NOT recoverable; those operations raise
+``BackendUnavailable`` and the job driver's lease retry re-prepares the
+reports from the datastore — by then the breaker is open, so the retry
+lands on the oracle.  Zero report loss via retry, not buffer recovery.
+
+Env knobs (docs/RESILIENCE.md):
+JANUS_BACKEND_PROBE_TIMEOUT (bootstrap, binaries.py) /
+JANUS_ENGINE_LAUNCH_TIMEOUT_S / JANUS_ENGINE_FALLBACK_TRIP /
+JANUS_ENGINE_REPROMOTE / JANUS_ENGINE_PROBE_TIMEOUT_S /
+JANUS_ENGINE_PROBE_INITIAL_S / JANUS_ENGINE_PROBE_MAX_S.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from janus_tpu import flight_recorder, metrics, trace
+from janus_tpu.core.retries import Backoff
+
+# Backend failures that surface mid-run, after startup probing passed:
+# the flaky tunnel drops and the next eager op raises from deep inside
+# jax.  Sourced from production traces (BENCH_r05); bench.py imports
+# this tuple so the bench and the service plane classify identically.
+_BACKEND_ERR_MARKERS = ("Unable to initialize backend",
+                       "backend setup/compile error")
+
+engine_state = metrics.REGISTRY.gauge(
+    "janus_engine_state",
+    "prepare-engine breaker state, 1 for the active state per kind "
+    "(device=serving on the accelerator, probing=demoted with re-promote "
+    "probe running, host=demoted without probe)")
+engine_calls_total = metrics.REGISTRY.counter(
+    "janus_engine_calls_total",
+    "reports served per engine path (path=device|host): the "
+    "device_availability SLI's good/total source")
+engine_demotions_total = metrics.REGISTRY.counter(
+    "janus_engine_demotions_total",
+    "breaker trips: device engine demoted to the host oracle, by kind")
+engine_repromotions_total = metrics.REGISTRY.counter(
+    "janus_engine_repromotions_total",
+    "breaker closes: demoted engine returned to the device path, by kind")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class BackendUnavailable(RuntimeError):
+    """The device backend is gone (classified marker, launch timeout, or
+    injected chaos).  Typed so callers can distinguish "retry later via
+    the oracle / lease retry" from a genuine logic error."""
+
+
+def is_backend_error(e: BaseException) -> bool:
+    """Is `e` a device-backend availability failure (vs a logic error)?"""
+    if isinstance(e, BackendUnavailable):
+        return True
+    msg = str(e)
+    return any(marker in msg for marker in _BACKEND_ERR_MARKERS)
+
+
+def raise_if_backend_error(e: BaseException) -> None:
+    """Hook for engine failure paths: re-raise a classified backend
+    failure as the typed BackendUnavailable; return for anything else
+    (the caller re-raises the original)."""
+    if not isinstance(e, BackendUnavailable) and is_backend_error(e):
+        raise BackendUnavailable(str(e)) from e
+
+
+# -- chaos injection (loadgen backend_loss fault; unit tests) ---------------
+
+_chaos_lock = threading.Lock()
+_chaos_active = False
+_chaos_until: float | None = None
+
+
+def inject_backend_loss(duration_s: float | None = None) -> None:
+    """Poison the device path: every guarded engine call classifies as a
+    backend failure until lift_backend_loss() (or `duration_s` elapses),
+    and re-promotion probes fail.  Process-local by design — the
+    inprocess soak and the unit suite share the engines they poison."""
+    global _chaos_active, _chaos_until
+    with _chaos_lock:
+        _chaos_active = True
+        _chaos_until = (time.monotonic() + duration_s
+                        if duration_s is not None else None)
+
+
+def lift_backend_loss() -> None:
+    """Heal the injected loss and nudge every demoted engine's probe
+    thread so re-promotion doesn't wait out the current backoff."""
+    global _chaos_active, _chaos_until
+    with _chaos_lock:
+        _chaos_active = False
+        _chaos_until = None
+    for eng in _registered_engines():
+        eng._breaker.wake.set()
+
+
+def backend_loss_active() -> bool:
+    global _chaos_active, _chaos_until
+    with _chaos_lock:
+        if not _chaos_active:
+            return False
+        if _chaos_until is not None and time.monotonic() >= _chaos_until:
+            _chaos_active = False
+            _chaos_until = None
+            return False
+        return True
+
+
+def _chaos_error() -> BackendUnavailable:
+    return BackendUnavailable(
+        "Unable to initialize backend 'chaos': injected backend_loss")
+
+
+# -- probes -----------------------------------------------------------------
+
+
+def probe_backend(timeout_s: float, op: bool = False):
+    """jax.devices() under a watchdog thread: the tunneled backend can
+    HANG during init instead of raising (socket connects, handshake
+    never completes).  A timeout is treated exactly like an init failure
+    — BackendUnavailable.  With ``op`` a tiny eager computation also
+    round-trips the device, which catches a backend that enumerates but
+    cannot launch.  Returns the device list."""
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            devices = jax.devices()
+            if op:
+                import jax.numpy as jnp
+                import numpy as np
+
+                np.asarray(jnp.arange(8, dtype=jnp.uint32)
+                           + jnp.uint32(1))
+            result["devices"] = devices
+        except BaseException as e:  # noqa: BLE001 — report, don't swallow
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True,
+                         name="backend-probe")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BackendUnavailable(
+            f"backend init timed out after {timeout_s:.0f}s")
+    if "error" in result:
+        raise result["error"]
+    return result["devices"]
+
+
+def _runtime_probe() -> None:
+    """The re-promotion health check: fail while chaos is injected, then
+    require a live device op under the runtime probe timeout."""
+    if backend_loss_active():
+        raise _chaos_error()
+    probe_backend(_env_float("JANUS_ENGINE_PROBE_TIMEOUT_S", 20.0), op=True)
+
+
+# -- the breaker ------------------------------------------------------------
+
+
+class _Breaker:
+    """Shared demotion state: one per top-level engine, shared by every
+    bound view (BatchPoplar1.bind returns a fresh engine per job — the
+    views must agree on the serving path)."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.lock = threading.Lock()
+        self.state = "device"  # device | probing | host
+        self.reason: str | None = None
+        self.demoted_at: float | None = None
+        self.demotions = 0
+        self.repromotions = 0
+        self.device_calls = 0
+        self.host_calls = 0
+        self.last_probe_error: str | None = None
+        self.fallback_baseline = 0
+        self.wake = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+
+    def set_gauge(self) -> None:
+        for s in ("device", "probing", "host"):
+            engine_state.set(1.0 if s == self.state else 0.0,
+                             kind=self.kind, state=s)
+
+
+class ResilientEngine:
+    """Circuit-breaker facade over a device prepare engine.
+
+    Closed ("device"): delegate to the inner engine, classifying every
+    failure.  Open ("probing"/"host"): serve through a lazily-built
+    HostPrepEngine oracle — bit-identical outputs, no device state.
+    """
+
+    def __init__(self, inner, probe_fn=None, probe_backoff: Backoff | None = None,
+                 _breaker: _Breaker | None = None):
+        self.inner = inner
+        self._probe_fn = probe_fn or _runtime_probe
+        self._probe_backoff = probe_backoff
+        self._oracle = None
+        self._oracle_lock = threading.Lock()
+        if _breaker is not None:
+            self._breaker = _breaker
+        else:
+            self._breaker = _Breaker(type(inner.vdaf).__name__)
+            self._breaker.set_gauge()
+            with _engines_lock:
+                _engines.add(self)
+
+    # -- facade ------------------------------------------------------------
+
+    @property
+    def vdaf(self):
+        return self.inner.vdaf
+
+    @property
+    def demoted(self) -> bool:
+        return self._breaker.state != "device"
+
+    @property
+    def state(self) -> str:
+        return self._breaker.state
+
+    @property
+    def device_ok(self) -> bool:
+        if self.demoted:
+            return False
+        return bool(getattr(self.inner, "device_ok", False))
+
+    @property
+    def fallback_count(self):
+        return self.inner.fallback_count
+
+    @property
+    def timings(self):
+        return getattr(self.inner, "timings", {})
+
+    def __getattr__(self, name):
+        # non-guarded surface (field/flp introspection, _host_helper,
+        # lane_upload_bytes, compiled-kernel caches for /debug/state)
+        return getattr(self.inner, name)
+
+    def oracle(self):
+        """The degraded-mode serving path: a HostPrepEngine over the SAME
+        vdaf instance, so prepare transcripts and aggregates are
+        byte-identical to the device path (the parity property the
+        streaming tests already pin)."""
+        with self._oracle_lock:
+            if self._oracle is None:
+                from janus_tpu.engine.host import HostPrepEngine
+
+                self._oracle = HostPrepEngine(self.inner.vdaf)
+            return self._oracle
+
+    def bind(self, agg_param: bytes):
+        bound = self.inner.bind(agg_param)
+        if bound is self.inner:
+            return self
+        # BatchPoplar1 binds a fresh engine per job; the bound view shares
+        # this engine's breaker so demotion applies across every job.
+        return ResilientEngine(bound, probe_fn=self._probe_fn,
+                               probe_backoff=self._probe_backoff,
+                               _breaker=self._breaker)
+
+    # -- breaker machinery -------------------------------------------------
+
+    def note_backend_failure(self, e: BaseException, where: str = "") -> bool:
+        """External failure report (the aggregator's fused-init call site
+        observes launch failures outside the guarded entry points).
+        Trips the breaker when `e` classifies; returns whether demoted."""
+        if is_backend_error(e):
+            self._trip(e, where=where)
+            return True
+        return False
+
+    def _count(self, path: str, n: int) -> None:
+        b = self._breaker
+        engine_calls_total.add(n, path=path, kind=b.kind)
+        with b.lock:
+            if path == "device":
+                b.device_calls += n
+            else:
+                b.host_calls += n
+
+    def _trip(self, exc: BaseException, where: str = "") -> None:
+        b = self._breaker
+        repromote = os.environ.get("JANUS_ENGINE_REPROMOTE", "1") not in (
+            "0", "false")
+        with b.lock:
+            if b.state != "device":
+                return
+            b.state = "probing" if repromote else "host"
+            b.reason = (f"{type(exc).__name__}: "
+                        f"{(str(exc) or repr(exc)).splitlines()[0][:200]}")
+            b.demoted_at = time.monotonic()
+            b.demotions += 1
+            b.last_probe_error = None
+        b.set_gauge()
+        engine_demotions_total.add(1, kind=b.kind)
+        flight_recorder.record(
+            "watchdog_stall", stall="engine_demoted", engine=b.kind,
+            where=where or None, reason=b.reason)
+        from janus_tpu import watchdog
+
+        watchdog.watchdog_stalls_total.add(1, kind="engine_demoted")
+        trace.warn("device engine demoted to host oracle",
+                   kind=b.kind, where=where, reason=b.reason)
+        if repromote:
+            self._start_probe()
+
+    def _start_probe(self) -> None:
+        b = self._breaker
+        with b.lock:
+            if b._probe_thread is not None and b._probe_thread.is_alive():
+                return
+            b.wake.clear()
+            t = threading.Thread(
+                target=self._probe_loop, daemon=True,
+                name=f"engine-repromote-{b.kind}")
+            b._probe_thread = t
+        t.start()
+
+    def _probe_loop(self) -> None:
+        b = self._breaker
+        backoff = self._probe_backoff or Backoff(
+            initial_interval=_env_float("JANUS_ENGINE_PROBE_INITIAL_S", 1.0),
+            max_interval=_env_float("JANUS_ENGINE_PROBE_MAX_S", 30.0),
+            multiplier=2.0, max_elapsed_time=None)
+        for interval in backoff.intervals():
+            if b.wake.wait(interval):
+                b.wake.clear()
+            if b.state == "device":
+                return
+            try:
+                self._probe_fn()
+            except BaseException as e:  # noqa: BLE001 — any failure = still down
+                with b.lock:
+                    b.last_probe_error = (
+                        str(e).splitlines()[0][:200] or repr(e))
+                continue
+            self._promote()
+            return
+
+    def _promote(self) -> None:
+        b = self._breaker
+        with b.lock:
+            if b.state == "device":
+                return
+            demoted_for = (time.monotonic() - b.demoted_at
+                           if b.demoted_at is not None else 0.0)
+            b.state = "device"
+            b.repromotions += 1
+            b.last_probe_error = None
+            # fresh fallback budget for the new device episode
+            b.fallback_baseline = int(getattr(self.inner,
+                                              "fallback_count", 0))
+        b.set_gauge()
+        engine_repromotions_total.add(1, kind=b.kind)
+        flight_recorder.record("engine_repromoted", engine=b.kind,
+                               demoted_for_s=round(demoted_for, 3))
+        trace.info("device engine re-promoted",
+                   kind=b.kind, demoted_for_s=round(demoted_for, 3))
+
+    def _check_fallback_trip(self) -> None:
+        """Optional trip condition: the device path is technically alive
+        but rerouting a flood of lanes through per-report host fallbacks
+        (fallback_count) — at that point the oracle serves them cheaper
+        and with one code path.  Disabled by default (0)."""
+        limit = int(_env_float("JANUS_ENGINE_FALLBACK_TRIP", 0.0))
+        if limit <= 0:
+            return
+        b = self._breaker
+        count = int(getattr(self.inner, "fallback_count", 0))
+        if count - b.fallback_baseline >= limit:
+            self._trip(BackendUnavailable(
+                f"fallback_count grew by {count - b.fallback_baseline} "
+                f">= JANUS_ENGINE_FALLBACK_TRIP={limit}"),
+                where="fallback_trip")
+
+    def _call_inner(self, fn, args):
+        """Invoke an inner entry point, optionally under a launch-timeout
+        watchdog thread (JANUS_ENGINE_LAUNCH_TIMEOUT_S; default off — the
+        device path is synchronous and a guard thread per launch is not
+        free)."""
+        timeout = _env_float("JANUS_ENGINE_LAUNCH_TIMEOUT_S", 0.0)
+        if timeout <= 0:
+            return fn(*args)
+        result: dict = {}
+
+        def work():
+            try:
+                result["value"] = fn(*args)
+            except BaseException as e:  # noqa: BLE001 — delivered to caller
+                result["error"] = e
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="engine-launch")
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            raise BackendUnavailable(
+                f"device launch timed out after {timeout:.0f}s")
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
+
+    # -- guarded entry points ---------------------------------------------
+
+    def _guarded(self, name: str, n: int, args: tuple):
+        """Serve `name` via the device path with demotion-on-failure, or
+        via the oracle when the breaker is open.  The call that observes
+        the failure is itself re-served on the oracle: zero loss."""
+        if not self.demoted and backend_loss_active():
+            self._trip(_chaos_error(), where=name)
+        if self.demoted:
+            self._count("host", n)
+            return getattr(self.oracle(), name)(*args)
+        try:
+            out = self._call_inner(getattr(self.inner, name), args)
+        except BaseException as e:
+            if is_backend_error(e):
+                self._trip(e, where=name)
+                self._count("host", n)
+                return self._oracle_retry(name, args)
+            raise
+        self._count("device", n)
+        self._check_fallback_trip()
+        return out
+
+    def _oracle_retry(self, name: str, args: tuple):
+        try:
+            return getattr(self.oracle(), name)(*args)
+        except BaseException as e:
+            # inputs that reference dead device state (LaneRef into lost
+            # HBM) cannot be recovered here; the lease retry re-prepares
+            raise_if_backend_error(e)
+            raise
+
+    def helper_init_batch(self, verify_key, nonces, public_shares,
+                          input_shares, inbound_messages):
+        return self._guarded(
+            "helper_init_batch", len(nonces),
+            (verify_key, nonces, public_shares, input_shares,
+             inbound_messages))
+
+    def leader_init_batch(self, verify_key, nonces, public_shares,
+                          input_shares):
+        return self._guarded(
+            "leader_init_batch", len(nonces),
+            (verify_key, nonces, public_shares, input_shares))
+
+    def leader_finish(self, reports, inbound_messages):
+        # host-side seed compare on both engines; route by breaker so a
+        # demoted engine never touches inner (whose lazy device constants
+        # could re-raise), and count it toward the availability SLI
+        return self._guarded("leader_finish", len(reports),
+                             (reports, inbound_messages))
+
+    def aggregate(self, reports):
+        rows = [rep.out_share_raw for rep in reports
+                if rep.status == "finished" and rep.out_share_raw is not None]
+        return self.aggregate_raw_rows(rows)
+
+    def _ints_to_raw(self, row: list):
+        """Oracle out_share_raw (list of field ints) -> the device
+        engine's [OUTPUT_LEN, LIMBS] little-endian u32 limb layout."""
+        limbs = int(getattr(self.inner, "L", 2))
+        return np.asarray([[(v >> (32 * k)) & 0xFFFFFFFF
+                            for k in range(limbs)] for v in row],
+                          dtype=np.uint32)
+
+    def aggregate_raw_rows(self, rows):
+        if not self.demoted and backend_loss_active():
+            self._trip(_chaos_error(), where="aggregate_raw_rows")
+        if self.demoted:
+            self._count("host", 1)
+            return self.oracle().aggregate_raw_rows(rows)
+        # oracle-prepared rows are plain int lists; the device engine's
+        # reduce consumes raw limb arrays — normalize so a job finished
+        # across a demote/re-promote boundary still aggregates (modular
+        # addition is exact: bit-identical either way)
+        rows = [self._ints_to_raw(r) if isinstance(r, list) else r
+                for r in rows]
+        try:
+            out = self._call_inner(self.inner.aggregate_raw_rows, (rows,))
+        except BaseException as e:
+            if is_backend_error(e):
+                self._trip(e, where="aggregate_raw_rows")
+                self._count("host", 1)
+                return self._oracle_retry("aggregate_raw_rows", (rows,))
+            raise
+        self._count("device", 1)
+        return out
+
+    # -- device-resident operations (no oracle equivalent) -----------------
+
+    def _device_only(self, name: str, args: tuple):
+        """Masked HBM reduces operate on device-resident share arrays; a
+        dead backend means those arrays are gone.  Raise the typed error
+        so the job driver's lease retry re-prepares — by then the breaker
+        is open and the retry serves through the oracle."""
+        if not self.demoted and backend_loss_active():
+            self._trip(_chaos_error(), where=name)
+        if self.demoted:
+            raise BackendUnavailable(
+                f"engine demoted to host oracle; device-resident operation "
+                f"{name} unavailable (lease retry re-prepares via the "
+                f"oracle)")
+        try:
+            return self._call_inner(getattr(self.inner, name), args)
+        except BaseException as e:
+            if is_backend_error(e):
+                self._trip(e, where=name)
+                raise_if_backend_error(e)
+            raise
+
+    def aggregate_masked_launch(self, shares, mask):
+        return self._device_only("aggregate_masked_launch", (shares, mask))
+
+    def aggregate_resolve(self, handle):
+        return self._device_only("aggregate_resolve", (handle,))
+
+    def aggregate_masked(self, shares, mask):
+        return self._device_only("aggregate_masked", (shares, mask))
+
+
+# -- registry (watchdog / health surface) -----------------------------------
+
+# WeakSet is not thread-safe; every access holds _engines_lock.
+_engines: "weakref.WeakSet[ResilientEngine]" = weakref.WeakSet()
+_engines_lock = threading.Lock()
+
+
+def _registered_engines() -> list:
+    with _engines_lock:
+        return list(_engines)
+
+
+def engines_snapshot() -> list[dict]:
+    """Per-engine breaker state for /debug/watchdog and the soak scraper:
+    demote + re-promote cycles must be operator-visible."""
+    out = []
+    now = time.monotonic()
+    for eng in _registered_engines():
+        try:
+            b = eng._breaker
+            with b.lock:
+                out.append({
+                    "kind": b.kind,
+                    "state": b.state,
+                    "demoted": b.state != "device",
+                    "reason": b.reason,
+                    "demoted_for_s": (round(now - b.demoted_at, 3)
+                                      if b.state != "device"
+                                      and b.demoted_at is not None else None),
+                    "demotions": b.demotions,
+                    "repromotions": b.repromotions,
+                    "device_calls": b.device_calls,
+                    "host_calls": b.host_calls,
+                    "last_probe_error": b.last_probe_error,
+                    "fallback_count": int(getattr(eng.inner,
+                                                  "fallback_count", 0)),
+                })
+        except Exception:  # engine mid-teardown; skip
+            continue
+    return out
+
+
+def any_demoted() -> int:
+    """Count of engines currently serving via the host oracle (the
+    /healthz degraded surface)."""
+    return sum(1 for e in engines_snapshot() if e["demoted"])

@@ -246,7 +246,19 @@ class HealthServer:
                         k, _, v = part.partition("=")
                         query[k] = v
                 if path == "/healthz":
+                    # degraded-but-serving: the host oracle keeps answers
+                    # byte-identical, so a demoted engine is still 200 —
+                    # but the body says so, for operators and LB logs
                     body = b"ok"
+                    try:
+                        from janus_tpu.engine import resilient
+
+                        demoted = resilient.any_demoted()
+                        if demoted:
+                            body = (f"ok (degraded: {demoted} engine(s) "
+                                    "serving via host oracle)").encode()
+                    except Exception:
+                        pass  # the probe surface must never 500
                     ctype = "text/plain"
                 elif path == "/metrics":
                     if _openmetrics_requested(self.headers.get("Accept")):

@@ -92,6 +92,47 @@ def _alert_analysis(slo_series: dict) -> dict:
     return analysis
 
 
+def _degraded_analysis(engine_series: list) -> dict:
+    """Demote/re-promote windows per (service, engine kind) from the
+    scraped breaker-state trajectory, plus the final counters — the
+    chaos-smoke gate reads `demotions`/`repromotions` from here.
+
+    Window edges are scrape-tick resolution: `demoted_at_s` is the first
+    tick that observed the engine demoted, `repromoted_at_s` the first
+    tick after it returned to the device path (None if still demoted at
+    run end)."""
+    windows: list = []
+    open_at: dict = {}    # (service, kind) -> first demoted tick
+    final: dict = {}      # (service, kind) -> last engine snapshot
+    for point in engine_series:
+        t, svc = point["t"], point["service"]
+        for eng in point.get("engines", []):
+            key = (svc, eng.get("kind"))
+            final[key] = eng
+            if eng.get("demoted"):
+                open_at.setdefault(key, t)
+            elif key in open_at:
+                t0 = open_at.pop(key)
+                windows.append({
+                    "service": svc, "kind": eng.get("kind"),
+                    "demoted_at_s": t0, "repromoted_at_s": t,
+                    "duration_s": round(t - t0, 3)})
+    for (svc, kind), t0 in sorted(open_at.items()):
+        windows.append({"service": svc, "kind": kind, "demoted_at_s": t0,
+                        "repromoted_at_s": None, "duration_s": None})
+    return {
+        "windows": windows,
+        "demotions": sum(e.get("demotions", 0) for e in final.values()),
+        "repromotions": sum(e.get("repromotions", 0)
+                            for e in final.values()),
+        "device_calls": sum(e.get("device_calls", 0)
+                            for e in final.values()),
+        "host_calls": sum(e.get("host_calls", 0) for e in final.values()),
+        "engines_final": [dict(e, service=svc)
+                          for (svc, _kind), e in sorted(final.items())],
+    }
+
+
 def build_artifact(*, config: dict, generator, scraper, audit: dict,
                    acceptance_objective: float = 0.99,
                    burn_alert: float = 2.0, collections: list | None = None,
@@ -144,6 +185,10 @@ def build_artifact(*, config: dict, generator, scraper, audit: dict,
             "stall_events": scraper.stall_events,
             "final": scraper.watchdog_last,
         },
+        # backend-loss resilience: demote->re-promote windows observed by
+        # the scraper (engine/resilient.py breakers via /debug/watchdog)
+        "degraded": _degraded_analysis(
+            getattr(scraper, "engine_series", [])),
         "funnel": {
             "tasks": audit.get("merged", {}),
             "aggregate": audit.get("aggregate", {}),

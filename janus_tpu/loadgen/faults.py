@@ -18,6 +18,13 @@ mapping):
 ``malformed``/``expired``/``clock_skewed`` reject before ``validated``
 and therefore burn the upload_acceptance SLI; the expected burn of a run
 is computed from the ACTUAL injected counts the generator records.
+
+A fifth kind, ``backend_loss`` (``BackendLossInjector``), is different
+in nature: it corrupts the ENVIRONMENT, not a report — poisoning the
+device engines for a wall-clock window so the resilient breakers demote
+to the host oracle and re-promote after (engine/resilient.py).  It burns
+the device_availability SLI and must NOT burn conservation: the oracle
+serves byte-identical results.
 """
 
 from __future__ import annotations
@@ -90,6 +97,68 @@ class FaultInjector:
         if self.fraction and self.rng.random() < self.fraction:
             return self.mix.pick(self.rng)
         return None
+
+
+BACKEND_LOSS = "backend_loss"
+
+
+class BackendLossInjector:
+    """Arms a device-backend outage window for the soak run.
+
+    Unlike the per-upload faults above, ``backend_loss`` is an
+    ENVIRONMENT fault: at ``start_s`` into the load it poisons the
+    resilient engines' device path (engine/resilient.py chaos hooks) and
+    at ``end_s`` it lifts the poison, waking the re-promotion probes.
+    Every guarded engine call in the window classifies as a backend
+    failure, so the breakers open, traffic demotes to the host oracle
+    (bit-identical — the funnel conservation audit must still pass), and
+    after ``end_s`` the engines re-promote.  Timer threads, wall-clock
+    scheduled relative to ``arm()``.
+    """
+
+    def __init__(self, start_s: float, end_s: float):
+        if not 0.0 <= start_s < end_s:
+            raise ValueError("backend-loss window must satisfy "
+                             "0 <= start < end")
+        self.start_s = start_s
+        self.end_s = end_s
+        self._timers: list = []
+        self.injected_at: float | None = None
+        self.lifted_at: float | None = None
+
+    def arm(self) -> "BackendLossInjector":
+        import threading
+        import time
+
+        from janus_tpu.engine import resilient
+
+        t0 = time.monotonic()
+
+        def poison():
+            self.injected_at = round(time.monotonic() - t0, 3)
+            resilient.inject_backend_loss()
+
+        def lift():
+            self.lifted_at = round(time.monotonic() - t0, 3)
+            resilient.lift_backend_loss()
+
+        start = threading.Timer(self.start_s, poison)
+        end = threading.Timer(self.end_s, lift)
+        for t in (start, end):
+            t.daemon = True
+            t.start()
+        self._timers = [start, end]
+        return self
+
+    def cancel(self) -> None:
+        """Cancel pending timers and ensure the poison is lifted (run
+        teardown must never leave the process-global flag set)."""
+        from janus_tpu.engine import resilient
+
+        for t in self._timers:
+            t.cancel()
+        self._timers = []
+        resilient.lift_backend_loss()
 
 
 def tamper_leader_ciphertext(report: Report) -> Report:

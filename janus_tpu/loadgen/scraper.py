@@ -100,6 +100,10 @@ class Scraper(threading.Thread):
         self.funnel_last: dict = {}    # service -> /debug/funnel "tasks"
         self.watchdog_last: dict = {}  # service -> last verdict
         self.stall_events: list = []   # [{"t", "service", "stalls"}]
+        # breaker-state trajectory from the watchdog payload's "engines"
+        # section: [{"t", "service", "engines": [{kind, state, ...}]}] —
+        # the artifact derives demote/re-promote windows from this
+        self.engine_series: list = []
         self.metrics_last: dict = {}   # service -> exposition text
         self.scrapes = 0
         self.errors: dict = {}         # service -> error count
@@ -162,6 +166,9 @@ class Scraper(threading.Thread):
         if watchdog.get("stalls"):
             self.stall_events.append(
                 {"t": t, "service": name, "stalls": watchdog["stalls"]})
+        if watchdog.get("engines"):
+            self.engine_series.append(
+                {"t": t, "service": name, "engines": watchdog["engines"]})
 
     # -- derived views -----------------------------------------------------
 

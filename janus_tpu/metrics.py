@@ -345,6 +345,11 @@ upload_open_stragglers = REGISTRY.counter(
 helper_rtt_seconds = REGISTRY.histogram(
     "janus_helper_rtt_seconds",
     "leader->helper request round-trip latency (incl. retries) by method")
+helper_unreachable_total = REGISTRY.counter(
+    "janus_helper_unreachable_total",
+    "leader->helper attempts that failed at the connection layer "
+    "(refused/timeout/DNS), by method and cause — a helper OUTAGE signal, "
+    "disjoint from retryable HTTP statuses and slow-RTT SLO burn")
 # streaming prepare data plane (engine/streaming.py, engine/batch.py):
 # the EWMA link estimate driving adaptive chunk/coalesce sizing, and the
 # host<->device transfer share of each prepare launch

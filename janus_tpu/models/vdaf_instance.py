@@ -200,13 +200,16 @@ def prep_engine(inst: VdafInstance):
                 # VdafInstance, so every task with these VDAF parameters
                 # shares the launch queue (the verify key is a per-report
                 # kernel input, so mixed-task launches are safe).
-                engine = CoalescingEngine(BatchPrio3(vdaf))
+                from janus_tpu.engine.resilient import ResilientEngine
+
+                engine = ResilientEngine(CoalescingEngine(BatchPrio3(vdaf)))
             elif inst.kind == "Poplar1":
                 # batched IDPF walk + sketch on device, every level: Field64
                 # inner walk/sketch and the Field255 leaf (ops/field255.py)
                 from janus_tpu.engine.batch_poplar1 import BatchPoplar1
+                from janus_tpu.engine.resilient import ResilientEngine
 
-                engine = BatchPoplar1(vdaf)
+                engine = ResilientEngine(BatchPoplar1(vdaf))
             else:
                 # Fake* test VDAFs run the per-report oracle on the host
                 from janus_tpu.engine.host import HostPrepEngine

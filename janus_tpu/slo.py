@@ -2,7 +2,7 @@
 workbook-style fast/slow-window alerting, computed in-process from the
 metrics registry — no external rules engine).
 
-Five SLIs, each reduced to good/total event counts over a sliding
+Six SLIs, each reduced to good/total event counts over a sliding
 window so every one of them burns a single error budget:
 
   * ``upload_acceptance``  — funnel ``validated`` / ``uploaded``
@@ -13,6 +13,9 @@ window so every one of them burns a single error budget:
     (``janus_helper_rtt_seconds``)
   * ``device_occupancy``   — device batches above the minimum occupancy
     (``janus_device_batch_occupancy``)
+  * ``device_availability``— engine calls served on the device path vs
+    the demoted host oracle (``janus_engine_calls_total``; see
+    engine/resilient.py and docs/RESILIENCE.md)
 
 The engine snapshots the raw cumulative counts (``sample()``), keeps a
 bounded history, and ``evaluate()`` computes each SLI over the fast and
@@ -29,7 +32,8 @@ JANUS_SLO_WINDOW_FAST_S / JANUS_SLO_WINDOW_SLOW_S /
 JANUS_SLO_SAMPLE_INTERVAL_S / JANUS_SLO_BURN_ALERT /
 JANUS_SLO_UPLOAD_ACCEPTANCE / JANUS_SLO_PREPARE_SUCCESS /
 JANUS_SLO_STEP_P99_S / JANUS_SLO_HELPER_RTT_P99_S /
-JANUS_SLO_OCCUPANCY_MIN / JANUS_SLO_OCCUPANCY_RATIO.
+JANUS_SLO_OCCUPANCY_MIN / JANUS_SLO_OCCUPANCY_RATIO /
+JANUS_SLO_DEVICE_AVAILABILITY.
 """
 
 from __future__ import annotations
@@ -98,6 +102,11 @@ def default_objectives() -> list[SloObjective]:
             _env_float("JANUS_SLO_OCCUPANCY_RATIO", 0.9),
             "device batches launched above the minimum lane occupancy",
             threshold=_env_float("JANUS_SLO_OCCUPANCY_MIN", 0.2)),
+        SloObjective(
+            "device_availability",
+            _env_float("JANUS_SLO_DEVICE_AVAILABILITY", 0.9),
+            "prepare/aggregate engine calls served on the device path "
+            "(vs the degraded host oracle after a breaker demotion)"),
     ]
 
 
@@ -123,12 +132,24 @@ def _funnel_stage_totals() -> dict[str, int]:
     return totals
 
 
+def _engine_call_totals() -> dict[str, int]:
+    """janus_engine_calls_total summed by serving path (device/host)."""
+    from janus_tpu.engine import resilient
+
+    totals: dict[str, int] = {}
+    for key, v in resilient.engine_calls_total.snapshot():
+        path = dict(key).get("path", "?")
+        totals[path] = totals.get(path, 0) + int(v)
+    return totals
+
+
 def _raw_sample() -> dict:
     return {
         "funnel": _funnel_stage_totals(),
         "step": _agg_hist(metrics.job_step_time),
         "rtt": _agg_hist(metrics.helper_rtt_seconds),
         "occupancy": _agg_hist(metrics.device_batch_occupancy),
+        "engine_calls": _engine_call_totals(),
     }
 
 
@@ -189,6 +210,13 @@ def _good_total(obj: SloObjective, cur: dict, ref: dict) -> tuple[int, int]:
         bad = _under_threshold(metrics.device_batch_occupancy.buckets,
                                counts, obj.threshold)
         return total - bad, total
+    if obj.sli == "device_availability":
+        # .get: samples recorded before this SLI existed lack the key
+        e_cur = cur.get("engine_calls", {})
+        e_ref = ref.get("engine_calls", {})
+        good = e_cur.get("device", 0) - e_ref.get("device", 0)
+        total = good + e_cur.get("host", 0) - e_ref.get("host", 0)
+        return min(good, total), total
     raise ValueError(f"unknown SLI {obj.sli!r}")
 
 

@@ -222,9 +222,20 @@ class Watchdog:
         with self._lock:
             self._reported &= current_keys
 
+        # breaker state of every registered prepare engine: demoted-but-
+        # serving is NOT a stall (the oracle is a correct degraded mode),
+        # so it rides alongside the verdict without flipping "ok"
+        try:
+            from janus_tpu.engine import resilient
+
+            engines = resilient.engines_snapshot()
+        except Exception:
+            engines = []
+
         return {
             "ok": not stalls,
             "stalls": stalls,
+            "engines": engines,
             "watched": {"jobs": len(jobs), "pipelines": len(pipelines),
                         "writers": len(writers)},
             "thresholds": {

@@ -99,6 +99,12 @@ def parse_args(argv=None):
                     help="run-progress window [a,b) during which faults "
                          "inject — a window ending before 1.0 lets the "
                          "burn alert demonstrably CLEAR")
+    ap.add_argument("--backend-loss", default=None,
+                    help="run-progress window [a,b) during which the "
+                         "device backend is poisoned (loadgen/faults.py "
+                         "BackendLossInjector): engines demote to the "
+                         "host oracle, then re-promote after b — the "
+                         "artifact's degraded section records the cycle")
     ap.add_argument("--scrape-interval", type=float, default=None,
                     help="telemetry poll period (default: duration/60, "
                          "clamped to [0.5, 5])")
@@ -540,6 +546,12 @@ def main(argv=None) -> int:
                   matrix[vdaf_names[i % len(vdaf_names)]])
                  for i in range(args.tasks)]
 
+    if args.backend_loss:
+        # soak-scale re-promotion cadence: probe quickly once the window
+        # lifts so the recovery lands well inside the drain phase
+        os.environ.setdefault("JANUS_ENGINE_PROBE_INITIAL_S", "0.5")
+        os.environ.setdefault("JANUS_ENGINE_PROBE_MAX_S", "2.0")
+
     mix = FaultMix.parse(args.bad_mix) if args.bad_mix else FaultMix()
     config = LoadConfig(
         duration_s=args.duration, rate_rps=args.rate,
@@ -556,6 +568,7 @@ def main(argv=None) -> int:
     topo = (InProcessTopology(args, task_defs) if args.mode == "inprocess"
             else ComposeTopology(args, task_defs))
     rc = 1
+    backend_loss = None
     try:
         workloads = build_workloads(args, topo, task_defs)
         if args.mode == "inprocess" and not args.no_warm:
@@ -563,6 +576,16 @@ def main(argv=None) -> int:
         generator = LoadGenerator(config, workloads)
         scraper = Scraper(topo.health_services, interval_s=scrape_interval)
         scraper.start()
+        if args.backend_loss:
+            from janus_tpu.loadgen.faults import BackendLossInjector
+
+            lo, hi = _fault_window(args.backend_loss)
+            backend_loss = BackendLossInjector(
+                max(lo * args.duration, 0.001),
+                hi * args.duration).arm()
+            log(f"backend-loss armed: device poison "
+                f"+{backend_loss.start_s:.1f}s .. "
+                f"+{backend_loss.end_s:.1f}s into the load")
         run_start = time.time()
         log("load generation started")
         generator.run()
@@ -610,6 +633,7 @@ def main(argv=None) -> int:
                 "scrape_interval_s": scrape_interval,
                 "seed": args.seed, "workers": args.workers,
                 "job_size": args.job_size, "top_up_reports": fillers,
+                "backend_loss": args.backend_loss,
             },
             generator=generator, scraper=scraper, audit=audit,
             acceptance_objective=float(os.environ.get(
@@ -622,6 +646,12 @@ def main(argv=None) -> int:
 
         alerts = artifact["slo"]["alerts"].get("upload_acceptance", {})
         log(f"artifact: {out}")
+        degraded = artifact.get("degraded", {})
+        if args.backend_loss or degraded.get("demotions"):
+            log(f"degraded windows: {len(degraded.get('windows', []))} "
+                f"(demotions={degraded.get('demotions', 0)}, "
+                f"repromotions={degraded.get('repromotions', 0)}, "
+                f"host_calls={degraded.get('host_calls', 0)})")
         log(f"upload_acceptance: max fast burn "
             f"{alerts.get('max_fast_burn')}, fired={alerts.get('fired')} "
             f"cleared={alerts.get('cleared')}")
@@ -639,6 +669,8 @@ def main(argv=None) -> int:
         for a in audit["anomalies"]:
             log(f"anomaly: {a}")
     finally:
+        if backend_loss is not None:
+            backend_loss.cancel()
         topo.stop()
     return rc
 

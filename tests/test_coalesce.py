@@ -91,6 +91,7 @@ def test_service_plane_concurrent_jobs_share_one_launch():
     from test_helper_http import _LeaderOracle, _helper_fixture
 
     from janus_tpu.engine.coalesce import CoalescingEngine
+    from janus_tpu.engine.resilient import ResilientEngine
     from janus_tpu.messages import (
         TIME_INTERVAL,
         AggregationJobId,
@@ -103,8 +104,12 @@ def test_service_plane_concurrent_jobs_share_one_launch():
     builder, task, clock, ds, agg, server = _helper_fixture()
     try:
         ta = agg.task_aggregator(builder.task_id)
-        assert isinstance(ta.engine, CoalescingEngine)
-        ta.engine.max_delay = 0.25  # deterministic packing window for CI
+        # the service default wraps the coalescer in the backend-loss
+        # circuit breaker; the coalescing plane sits directly inside it
+        assert isinstance(ta.engine, ResilientEngine)
+        coal = ta.engine.inner
+        assert isinstance(coal, CoalescingEngine)
+        coal.max_delay = 0.25  # deterministic packing window for CI
         oracle = _LeaderOracle(builder, clock)
         n = 40
 
@@ -117,7 +122,7 @@ def test_service_plane_concurrent_jobs_share_one_launch():
                 prepare_inits=inits).encode()
 
         bodies = [body(j) for j in range(2)]
-        before = ta.engine.inner.timings["batches"]
+        before = coal.inner.timings["batches"]
 
         def run(j):
             return agg.handle_aggregate_init(
@@ -126,7 +131,7 @@ def test_service_plane_concurrent_jobs_share_one_launch():
 
         with ThreadPoolExecutor(2) as pool:
             resps = list(pool.map(run, range(2)))
-        assert ta.engine.inner.timings["batches"] - before == 1
+        assert coal.inner.timings["batches"] - before == 1
         for resp in resps:
             decoded = AggregationJobResp.decode(resp)
             assert len(decoded.prepare_resps) == n

@@ -1,0 +1,533 @@
+"""Serve-through-failure resilience: the watchdogged bootstrap probe
+(binaries._probe_accelerator / resilient.probe_backend), the
+ResilientEngine circuit breaker — demotion to the bit-identical host
+oracle on a classified backend loss, background re-promotion once the
+device returns — and the operator surfaces (/debug/watchdog, /healthz,
+the device_availability SLI) that make a degraded engine visible.
+
+The parity assertions reuse the report harness from test_streaming.py:
+statuses, outbound prepare messages and aggregates must be
+BYTE-IDENTICAL whichever path served them — that property is what makes
+zero-loss demotion sound (retried requests hash identically, so the
+helper's replay dedup and the funnel conservation audit both hold)."""
+
+import threading
+import time
+
+import pytest
+from test_streaming import _mk_leader_reports, _mk_reports
+
+from janus_tpu import flight_recorder, watchdog
+from janus_tpu.core.retries import Backoff
+from janus_tpu.engine import resilient
+from janus_tpu.engine.batch import BatchPrio3
+from janus_tpu.engine.host import HostPrepEngine
+from janus_tpu.engine.resilient import BackendUnavailable, ResilientEngine
+from janus_tpu.models import VdafInstance
+from janus_tpu.models.vdaf_instance import vdaf_for_instance
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leaks():
+    """The chaos flag and the engine registry are process-global; a test
+    must never leave the device path poisoned (or an engine demoted) for
+    the rest of the suite."""
+    yield
+    resilient.lift_backend_loss()
+    for eng in resilient._registered_engines():
+        eng._promote()
+        eng._breaker.wake.set()
+
+
+def _fast_backoff() -> Backoff:
+    return Backoff(initial_interval=0.01, max_interval=0.05,
+                   multiplier=2.0, max_elapsed_time=None, jitter=0.0)
+
+
+def _still_down():
+    raise BackendUnavailable("probe: still down")
+
+
+def _wait_for(pred, timeout_s: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+class _DeadBackendEngine:
+    """Inner engine whose device dispatch raises the production backend
+    marker (the mid-run tunnel drop bench.py saw in BENCH_r05)."""
+
+    def __init__(self, vdaf):
+        self.vdaf = vdaf
+        self.fallback_count = 0
+        self.calls = 0
+
+    def bind(self, agg_param: bytes):
+        return self
+
+    def _die(self):
+        self.calls += 1
+        raise RuntimeError("Unable to initialize backend 'axon': "
+                           "UNAVAILABLE: socket closed")
+
+    def helper_init_batch(self, *a):
+        self._die()
+
+    def leader_init_batch(self, *a):
+        self._die()
+
+    def aggregate_raw_rows(self, rows):
+        self._die()
+
+
+# -- classification ---------------------------------------------------------
+
+
+def test_backend_error_classification():
+    assert resilient.is_backend_error(
+        RuntimeError("Unable to initialize backend 'axon': UNAVAILABLE"))
+    assert resilient.is_backend_error(
+        Exception("jit apply: backend setup/compile error"))
+    assert resilient.is_backend_error(BackendUnavailable("poof"))
+    assert not resilient.is_backend_error(ValueError("bad share length"))
+    # bench.py classifies with the SAME marker tuple (imported, not copied)
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench
+
+    assert bench._BACKEND_ERR_MARKERS is resilient._BACKEND_ERR_MARKERS
+
+
+def test_raise_if_backend_error_wraps_only_classified():
+    with pytest.raises(BackendUnavailable):
+        try:
+            raise RuntimeError("Unable to initialize backend 'x'")
+        except RuntimeError as e:
+            resilient.raise_if_backend_error(e)
+    # non-backend errors pass through untouched for the caller to re-raise
+    try:
+        raise ValueError("logic error")
+    except ValueError as e:
+        resilient.raise_if_backend_error(e)  # must not raise
+
+
+# -- bootstrap watchdog -----------------------------------------------------
+
+
+def test_probe_backend_times_out_on_hung_init(monkeypatch):
+    """A black-holed accelerator tunnel makes jax.devices() HANG rather
+    than raise; the watchdog thread turns that into BackendUnavailable
+    within the timeout instead of wedging startup forever."""
+    import jax
+
+    release = threading.Event()
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **k: release.wait(30))
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(BackendUnavailable, match="timed out"):
+            resilient.probe_backend(0.2)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        release.set()  # unhang the daemon probe thread
+
+
+def test_probe_backend_propagates_init_error(monkeypatch):
+    import jax
+
+    def boom():
+        raise RuntimeError("Unable to initialize backend 'axon'")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    with pytest.raises(RuntimeError, match="Unable to initialize"):
+        resilient.probe_backend(5.0)
+
+
+def test_probe_backend_returns_devices_and_runs_op():
+    devices = resilient.probe_backend(30.0, op=True)
+    assert devices
+
+
+def test_probe_accelerator_honors_timeout_env(monkeypatch):
+    """binaries._probe_accelerator reads JANUS_BACKEND_PROBE_TIMEOUT and
+    hands it to the watchdogged probe (default 90 s)."""
+    from janus_tpu import binaries
+
+    seen: list = []
+
+    def fake_probe(timeout_s, op=False):
+        seen.append(timeout_s)
+
+        class _Dev:
+            platform = "cpu"
+
+        return [_Dev()]
+
+    monkeypatch.setattr(resilient, "probe_backend", fake_probe)
+    monkeypatch.setenv("JANUS_BACKEND_PROBE_TIMEOUT", "7.5")
+    binaries._probe_accelerator()
+    assert seen == [7.5]
+
+
+def test_probe_accelerator_falls_back_to_cpu_on_timeout(monkeypatch):
+    """A hung/failed first probe demotes bootstrap to CPU — and the CPU
+    re-probe is ALSO watchdogged (the hung thread can hold jax's global
+    backend lock)."""
+    from janus_tpu import binaries
+
+    calls: list = []
+
+    def fake_probe(timeout_s, op=False):
+        calls.append(timeout_s)
+        if len(calls) == 1:
+            raise BackendUnavailable("backend init timed out after 1s")
+
+        class _Dev:
+            platform = "cpu"
+
+        return [_Dev()]
+
+    monkeypatch.setattr(resilient, "probe_backend", fake_probe)
+    monkeypatch.setenv("JANUS_BACKEND_PROBE_TIMEOUT", "1")
+    binaries._probe_accelerator()
+    assert len(calls) == 2  # failed device probe, then the guarded CPU one
+
+
+# -- demotion: byte-identical degraded serving ------------------------------
+
+
+def test_backend_loss_demotes_serves_identically_and_repromotes():
+    """The full chaos cycle on a real device engine: poison -> the next
+    batch trips the breaker and is re-served through the host oracle
+    (bit-identical statuses/messages/aggregates, zero loss) -> lifting
+    the poison wakes the probe -> the breaker closes and the next batch
+    runs on the device path again."""
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    n = 60
+    nonces, pubs, shares, inits = _mk_reports(vdaf, vk, n)
+    shares = list(shares)
+    shares[7] = shares[7][:-1] + bytes([shares[7][-1] ^ 1])  # one bad lane
+
+    device = BatchPrio3(vdaf)
+    want = device.helper_init_batch(vk, nonces, pubs, shares, inits)
+
+    eng = ResilientEngine(BatchPrio3(vdaf), probe_backoff=_fast_backoff())
+    assert eng.state == "device" and not eng.demoted
+
+    resilient.inject_backend_loss()
+    got = eng.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert eng.demoted and eng.state == "probing"
+    b = eng._breaker
+    assert b.demotions == 1 and b.host_calls == n and b.device_calls == 0
+    # the degraded path is BYTE-identical to the device path
+    assert [r.status for r in got] == [r.status for r in want]
+    assert [r.outbound.encode() if r.outbound else None for r in got] == \
+           [r.outbound.encode() if r.outbound else None for r in want]
+    assert eng.aggregate(got) == device.aggregate(want)
+    # a second poisoned call must NOT re-trip (idempotent open breaker)
+    eng.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert b.demotions == 1
+
+    # demotion is on the flight recorder as a watchdog_stall
+    events = flight_recorder.snapshot(event="watchdog_stall")
+    assert any(e.get("stall") == "engine_demoted" for e in events)
+
+    resilient.lift_backend_loss()  # wakes the probe past its backoff
+    assert _wait_for(lambda: eng.state == "device")
+    assert b.repromotions == 1
+
+    got2 = eng.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert b.device_calls == n
+    assert [r.status for r in got2] == [r.status for r in want]
+    assert eng.aggregate(got2) == device.aggregate(want)
+
+
+def test_leader_path_parity_and_mixed_row_aggregation():
+    """Leader prepare under chaos matches the device transcript, and
+    oracle-prepared rows (plain int lists) aggregate on the re-promoted
+    DEVICE path bit-identically (the demote/re-promote boundary case)."""
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    n = 40
+    nonces, pubs, shares = _mk_leader_reports(vdaf, n)
+
+    device = BatchPrio3(vdaf)
+    want = device.leader_init_batch(vk, nonces, pubs, shares)
+
+    eng = ResilientEngine(BatchPrio3(vdaf), probe_backoff=_fast_backoff())
+    resilient.inject_backend_loss()
+    got = eng.leader_init_batch(vk, nonces, pubs, shares)
+    assert eng.demoted
+    assert [r.status for r in got] == [r.status for r in want]
+    assert [r.outbound.encode() if r.outbound else None for r in got] == \
+           [r.outbound.encode() if r.outbound else None for r in want]
+
+    oracle_rows = [r.out_share_raw for r in got
+                   if r.status == "continued"]
+    assert oracle_rows and all(isinstance(r, list) for r in oracle_rows)
+    device_rows = [r.out_share_raw for r in want
+                   if r.status == "continued"]
+
+    resilient.lift_backend_loss()
+    assert _wait_for(lambda: eng.state == "device")
+    # int-list rows normalize onto the device reduce; exact modular
+    # addition makes the result identical however the rows were prepared
+    assert eng.aggregate_raw_rows(oracle_rows) == \
+        device.aggregate_raw_rows(device_rows)
+
+
+def test_midcall_failure_reserved_on_oracle_with_zero_loss():
+    """The call that OBSERVES the backend failure is itself re-served on
+    the oracle — the caller sees results, not an exception."""
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    n = 24
+    nonces, pubs, shares, inits = _mk_reports(vdaf, vk, n)
+    inner = _DeadBackendEngine(vdaf)
+    eng = ResilientEngine(inner, probe_fn=_still_down,
+                          probe_backoff=_fast_backoff())
+
+    got = eng.helper_init_batch(vk, nonces, pubs, shares, inits)
+    assert inner.calls == 1          # the device attempt that died
+    assert eng._breaker.host_calls == n
+    want = HostPrepEngine(vdaf).helper_init_batch(
+        vk, nonces, pubs, shares, inits)
+    assert [r.status for r in got] == [r.status for r in want]
+    assert eng.aggregate(got) == HostPrepEngine(vdaf).aggregate(want)
+
+
+def test_non_backend_errors_do_not_trip():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+
+    class _BuggyEngine(_DeadBackendEngine):
+        def helper_init_batch(self, *a):
+            raise ValueError("a logic bug, not an outage")
+
+    eng = ResilientEngine(_BuggyEngine(vdaf))
+    with pytest.raises(ValueError):
+        eng.helper_init_batch(b"", [], [], [], [])
+    assert not eng.demoted
+    assert not eng.note_backend_failure(ValueError("still a bug"))
+    assert not eng.demoted
+
+
+def test_repromote_disabled_parks_in_host_state(monkeypatch):
+    monkeypatch.setenv("JANUS_ENGINE_REPROMOTE", "0")
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    eng = ResilientEngine(_DeadBackendEngine(vdaf))
+    assert eng.note_backend_failure(
+        RuntimeError("Unable to initialize backend 'axon'"), where="test")
+    assert eng.state == "host"
+    assert eng._breaker._probe_thread is None  # no probe: demotion is final
+
+
+def test_repromotion_waits_for_probe_success():
+    """The probe loop keeps failing (device still gone), then one
+    success closes the breaker."""
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    healthy = threading.Event()
+    attempts: list = []
+
+    def probe():
+        attempts.append(1)
+        if not healthy.is_set():
+            raise BackendUnavailable("still down")
+
+    eng = ResilientEngine(_DeadBackendEngine(vdaf), probe_fn=probe,
+                          probe_backoff=_fast_backoff())
+    eng.note_backend_failure(
+        RuntimeError("Unable to initialize backend 'axon'"), where="test")
+    assert eng.state == "probing"
+    assert _wait_for(lambda: len(attempts) >= 2)  # failing probes retry
+    assert eng.state == "probing"
+    assert eng._breaker.last_probe_error is not None
+    healthy.set()
+    assert _wait_for(lambda: eng.state == "device")
+    assert eng._breaker.repromotions == 1
+    assert eng._breaker.last_probe_error is None
+
+
+def test_bound_view_shares_the_breaker():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+
+    class _Bindable(_DeadBackendEngine):
+        def bind(self, agg_param: bytes):
+            return _Bindable(self.vdaf)  # fresh engine per job
+
+    eng = ResilientEngine(_Bindable(vdaf), probe_fn=_still_down,
+                          probe_backoff=_fast_backoff())
+    view = eng.bind(b"")
+    assert isinstance(view, ResilientEngine)
+    assert view._breaker is eng._breaker
+    view.note_backend_failure(
+        RuntimeError("Unable to initialize backend 'axon'"), where="bound")
+    assert eng.demoted  # demotion through a view applies to every view
+
+
+def test_device_only_operations_raise_typed_when_demoted():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    eng = ResilientEngine(_DeadBackendEngine(vdaf), probe_fn=_still_down,
+                          probe_backoff=_fast_backoff())
+    eng.note_backend_failure(
+        RuntimeError("Unable to initialize backend 'axon'"), where="test")
+    with pytest.raises(BackendUnavailable, match="lease retry"):
+        eng.aggregate_masked_launch(object(), object())
+
+
+# -- operator surfaces ------------------------------------------------------
+
+
+def test_demotion_visible_at_watchdog_healthz_and_slo(monkeypatch):
+    """One demoted engine shows up everywhere an operator would look:
+    /debug/watchdog's engines section (without flipping the stall
+    verdict), /healthz's degraded body (still 200), and the
+    device_availability SLI burning in /debug/slo."""
+    import requests
+
+    from janus_tpu.health import HealthServer
+    from janus_tpu.slo import SloEngine, set_engine
+
+    monkeypatch.setenv("JANUS_ENGINE_REPROMOTE", "0")
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    vk = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    nonces, pubs, shares, inits = _mk_reports(vdaf, vk, 10)
+
+    t = [1_000.0]
+    slo_eng = SloEngine(fast_window_s=60, slow_window_s=600,
+                        burn_alert=2.0, time_fn=lambda: t[0])
+    slo_eng.sample()  # baseline before any degraded serving
+    set_engine(slo_eng)
+
+    eng = ResilientEngine(_DeadBackendEngine(vdaf))
+    eng.helper_init_batch(vk, nonces, pubs, shares, inits)  # trips -> oracle
+    assert eng.state == "host"
+
+    server = HealthServer(debug_console=True).start()
+    try:
+        wd = requests.get(f"{server.address}/debug/watchdog",
+                          timeout=5).json()
+        mine = [e for e in wd["engines"]
+                if e["state"] == "host" and e["demotions"] >= 1]
+        assert mine and mine[0]["host_calls"] >= 10
+        assert "Unable to initialize backend" in mine[0]["reason"]
+        # demoted-but-serving is NOT a stall: the verdict stays ok
+        assert wd["ok"] is True
+
+        hz = requests.get(f"{server.address}/healthz", timeout=5)
+        assert hz.status_code == 200  # the LB must NOT evict: still serving
+        assert "degraded" in hz.text and "host oracle" in hz.text
+
+        t[0] += 61
+        rep = slo_eng.evaluate()
+        avail = rep["slos"]["device_availability"]
+        assert avail["windows"]["fast"]["good"] == 0
+        assert avail["windows"]["fast"]["total"] == 10
+        assert avail["windows"]["fast"]["burn_rate"] > 2.0
+    finally:
+        server.stop()
+        set_engine(None)
+        eng._promote()
+
+    hz = None
+    server = HealthServer().start()
+    try:  # promoted again: the exact "ok" contract is restored
+        hz = requests.get(f"{server.address}/healthz", timeout=5)
+    finally:
+        server.stop()
+    assert hz is not None and hz.text == "ok"
+
+
+def test_engines_snapshot_and_metrics_instruments():
+    vdaf = vdaf_for_instance(VdafInstance.prio3_count())
+    eng = ResilientEngine(_DeadBackendEngine(vdaf), probe_fn=_still_down,
+                          probe_backoff=_fast_backoff())
+    before = resilient.engine_demotions_total.value(kind="Prio3")
+    eng.note_backend_failure(
+        RuntimeError("Unable to initialize backend 'axon'"), where="test")
+    assert resilient.engine_demotions_total.value(kind="Prio3") == before + 1
+    snap = [e for e in resilient.engines_snapshot() if e["demoted"]]
+    assert snap and snap[0]["kind"] == "Prio3"
+    assert snap[0]["demoted_for_s"] is not None
+    assert resilient.any_demoted() >= 1
+    assert resilient.engine_state.value(kind="Prio3", state="device") == 0.0
+    eng._promote()
+    assert resilient.engine_state.value(kind="Prio3", state="device") == 1.0
+    assert resilient.any_demoted() == 0
+
+
+def test_chaos_window_expires_on_its_own():
+    resilient.inject_backend_loss(duration_s=0.05)
+    assert resilient.backend_loss_active()
+    assert _wait_for(lambda: not resilient.backend_loss_active())
+
+
+def test_backend_loss_injector_arms_and_cancels():
+    from janus_tpu.loadgen.faults import BackendLossInjector
+
+    inj = BackendLossInjector(0.02, 30.0).arm()
+    try:
+        assert _wait_for(resilient.backend_loss_active, timeout_s=5.0)
+    finally:
+        inj.cancel()
+    assert not resilient.backend_loss_active()
+    with pytest.raises(ValueError):
+        BackendLossInjector(5.0, 5.0)
+
+
+# -- helper-unreachable classification (http_client satellite) --------------
+
+
+def test_unreachable_classification_and_counter():
+    import requests.exceptions as rex
+
+    from janus_tpu.aggregator.http_client import (_classify_unreachable,
+                                                  _count_unreachable)
+    from janus_tpu.metrics import helper_unreachable_total
+
+    refused = rex.ConnectionError("conn refused")
+    refused.__cause__ = ConnectionRefusedError(111, "Connection refused")
+    assert _classify_unreachable(refused) == "refused"
+    assert _classify_unreachable(rex.ConnectTimeout("t")) == "timeout"
+    assert _classify_unreachable(rex.ReadTimeout("t")) == "timeout"
+    assert _classify_unreachable(rex.ConnectionError("reset")) == "connect"
+    assert _classify_unreachable(ConnectionRefusedError()) == "refused"
+
+    before = helper_unreachable_total.value(method="PUT", cause="refused")
+    _count_unreachable("PUT", refused)
+    assert helper_unreachable_total.value(
+        method="PUT", cause="refused") == before + 1
+
+
+def test_peer_client_counts_refused_connection():
+    """A leader POSTing to a dead helper port increments the outage
+    counter with cause=refused (no HTTP status ever existed)."""
+    import socket
+
+    from janus_tpu.aggregator.http_client import PeerClient
+    from janus_tpu.core.retries import LimitedRetryer
+    from janus_tpu.metrics import helper_unreachable_total
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+
+    class _Task:
+        peer_aggregator_endpoint = f"http://127.0.0.1:{port}/"
+        aggregator_auth_token = None
+
+    client = PeerClient(backoff=LimitedRetryer(0), timeout=5)
+    before = helper_unreachable_total.value(method="POST", cause="refused")
+    with pytest.raises(Exception):
+        client.send_to_helper(_Task(), "POST", "x", b"", "text/plain")
+    assert helper_unreachable_total.value(
+        method="POST", cause="refused") == before + 1
