@@ -17,6 +17,16 @@ three checker families that run over the AST of every module:
 - ``crypto``     crypto hygiene: variable-time ``==`` on MAC/tag/seed
                  material, secret-dependent branching in the crypto cores,
                  float arithmetic touching field-limb tensors.
+- ``dataflow``   interprocedural dataflow over the repo-wide call graph
+                 (callgraph.py): secret-leak taint (sources in core/hpke,
+                 core/auth_tokens, vdaf/; sinks in logging, metrics,
+                 flight recorder, problem bodies, exception messages,
+                 artifact JSON; sanitizers cut the flow), retrace-storm /
+                 transitive host-sync hazards feeding jitted entry points,
+                 and whole-repo lock analysis (must-hold/may-acquire
+                 summaries, locked->unlocked helper calls, cross-module
+                 lock-order cycles, unlocked global writes, thread-role
+                 tags from Thread(target=...) spawn sites).
 
 Run it as ``python -m janus_lint`` (exit 0 = clean) or through the tier-1
 suite (tests/test_janus_lint.py).  See docs/STATIC_ANALYSIS.md.
@@ -90,9 +100,37 @@ RULES = {
     "float-in-field": (
         "float arithmetic (true division, float dtype) touching "
         "field-limb tensors"),
+    # interprocedural dataflow (dataflow.py + callgraph.py)
+    "secret-leak": (
+        "secret material (HPKE private key, auth token, joint-rand seed, "
+        "verify key, decrypted share) reaches a log line, metric label, "
+        "flight-recorder payload, problem body, exception message, or "
+        "serialized artifact — possibly through several calls"),
+    "retrace-storm": (
+        "a per-request Python size (len() of a report/share batch, not "
+        "bucketed) reaches a jit static key or a jnp shape constructor on "
+        "a hot path, forcing a recompile per distinct value"),
+    "transitive-host-sync": (
+        "a hot-path engine function transitively reaches a blocking "
+        "device->host sync (.item(), block_until_ready, device_get) "
+        "through a call chain PR 7's single-module pass cannot see"),
+    "locked-helper-unheld": (
+        "a *_locked helper that requires a lock is called on a path "
+        "where that lock is not held"),
+    "lock-held-reacquire": (
+        "a non-reentrant Lock may be re-acquired on a call path that "
+        "already holds it (self-deadlock)"),
+    "lock-order-cycle": (
+        "two locks are acquired in opposite orders on call paths that "
+        "cross at least one function boundary (deadlock hazard the "
+        "syntactic lock-order-inversion rule cannot see)"),
+    "unlocked-global-write": (
+        "a module global is mutated without a lock in a function "
+        "reachable from more than one thread role"),
     # typing (only emitted when mypy is importable; see typecheck.py)
     "mypy-strict": (
-        "mypy --strict diagnostic in janus_tpu/messages or janus_tpu/core"),
+        "mypy --strict diagnostic in janus_tpu/{messages,core}, or "
+        "relaxed-strict in janus_tpu/{engine,loadgen} (see typecheck.py)"),
     # meta
     "suppression-needs-reason": (
         "janus-lint suppression without a '-- <justification>' string"),
@@ -228,14 +266,21 @@ def iter_py_files(paths: list[str]) -> list[str]:
 
 def lint_source(src: str, path: str = "<string>",
                 rules: set[str] | None = None,
-                _order_edges: list | None = None) -> LintResult:
+                _order_edges: list | None = None,
+                _dataflow: bool = False,
+                _sups: "tuple[list[_Suppression], list[Finding]] | None"
+                = None,
+                _trees: "dict[str, ast.Module] | None" = None) -> LintResult:
     """Lint one module's source.  `rules`, when given, keeps only those
     rule ids (suppression-meta findings are always kept).  `_order_edges`
     collects cross-module lock-order edges for the repo-level inversion
-    pass."""
+    pass.  `_dataflow` additionally runs the interprocedural dataflow
+    families over this single module (fixture tests; lint_paths runs the
+    repo-wide pass instead).  `_sups` lets lint_paths pass in the
+    already-tokenized suppression table instead of re-tokenizing."""
     from janus_lint import crypto, jitpurity, locks
 
-    sups, meta = _parse_suppressions(src, path)
+    sups, meta = _sups if _sups is not None else _parse_suppressions(src, path)
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
@@ -244,6 +289,8 @@ def lint_source(src: str, path: str = "<string>",
             "jit-host-sync", path, e.lineno or 1, 0,
             f"file does not parse: {e.msg}"))
         return res
+    if _trees is not None:
+        _trees[path] = tree
     findings: list[Finding] = []
     lock_findings, edges = locks.check_module(tree, path)
     findings.extend(lock_findings)
@@ -251,6 +298,9 @@ def lint_source(src: str, path: str = "<string>",
         _order_edges.extend(edges)
     findings.extend(jitpurity.check_module(tree, path))
     findings.extend(crypto.check_module(tree, path))
+    if _dataflow:
+        from janus_lint import dataflow
+        findings.extend(dataflow.check_repo([(path, src)]))
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
     findings.extend(meta)
@@ -260,22 +310,40 @@ def lint_source(src: str, path: str = "<string>",
 
 def lint_paths(paths: list[str],
                rules: set[str] | None = None) -> LintResult:
-    """Lint every .py file under `paths`, then run the repo-level
-    lock-order inversion pass over the union of acquisition edges."""
-    from janus_lint import locks
+    """Lint every .py file under `paths`, then run the repo-level passes:
+    the lock-order inversion scan over the union of acquisition edges and
+    the interprocedural dataflow families (dataflow.py) over the whole
+    file set as one call graph.  Dataflow findings land on concrete
+    path:line sites, so the per-file suppression tables apply to them."""
+    from janus_lint import dataflow, locks
 
     result = LintResult()
     edges: list = []
+    sources: list[tuple[str, str]] = []
+    sups_by_path: dict[str, list[_Suppression]] = {}
+    trees: dict[str, ast.Module] = {}
     for path in iter_py_files(paths):
         try:
             with open(path, encoding="utf-8") as f:
                 src = f.read()
         except (OSError, UnicodeDecodeError):
             continue
+        sources.append((path, src))
+        parsed = _parse_suppressions(src, path)
+        sups_by_path[path] = parsed[0]
         result.extend(lint_source(src, path, rules=rules,
-                                  _order_edges=edges))
+                                  _order_edges=edges, _sups=parsed,
+                                  _trees=trees))
     order = locks.check_order(edges)
     if rules is not None:
         order = [f for f in order if f.rule in rules]
     result.active.extend(order)  # repo-level: not line-suppressable
+    flow = dataflow.check_repo(sources, trees=trees)
+    if rules is not None:
+        flow = [f for f in flow if f.rule in rules]
+    by_path: dict[str, list[Finding]] = {}
+    for f in flow:
+        by_path.setdefault(f.path, []).append(f)
+    for path, fs in by_path.items():
+        result.extend(_apply_suppressions(fs, sups_by_path.get(path, [])))
     return result

@@ -20,8 +20,9 @@ DEFAULT_TARGETS = ("janus_tpu", "janus_lint")
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="janus_lint",
-        description="janus-lint: lock discipline, jit purity, and crypto "
-                    "hygiene checks (docs/STATIC_ANALYSIS.md)")
+        description="janus-lint: lock discipline, jit purity, crypto "
+                    "hygiene, and interprocedural dataflow checks "
+                    "(docs/STATIC_ANALYSIS.md)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: janus_tpu/ and "
                          "janus_lint/)")
@@ -71,8 +72,14 @@ def main(argv: list[str] | None = None) -> int:
         }, indent=2))
         return 0 if result.clean else 1
 
+    annotate = os.environ.get("GITHUB_ACTIONS") == "true"
     for f in result.active:
         print(f.format())
+        if annotate:
+            # problem-matcher format: CI annotates the offending diff line
+            print(f"::error file={os.path.relpath(f.path, REPO_ROOT)},"
+                  f"line={f.line},col={f.col},title=janus-lint "
+                  f"{f.rule}::{f.message}")
     if args.show_suppressed:
         for f in result.suppressed:
             print(f.format())

@@ -1,0 +1,674 @@
+"""Repo-wide call graph over janus_tpu/ (the dataflow engine's substrate).
+
+Python has no linker, so the graph is built by *name resolution* over the
+repo's own conventions, best-effort and unsound in the usual static-analysis
+sense — good enough to carry taint, host-sync, and lock summaries across the
+calls this codebase actually writes:
+
+- module functions and classes, resolved through ``import``/``from import``
+  (including one level of package re-export, e.g.
+  ``janus_tpu.engine.prep_engine``);
+- methods, with the receiver type inferred from (a) ``self.x = ClassName(...)``
+  assignments in ``__init__``, (b) ``__init__`` parameter annotations stored
+  onto ``self`` (``def __init__(self, inner: BatchPrio3): self.inner = inner``),
+  (c) local ``v = ClassName(...)`` bindings, and (d) repo-class base classes;
+- first-order callbacks: ``jax.jit(fn)``, ``threading.Thread(target=fn)``,
+  ``executor.submit(fn, ...)``, ``functools.partial(fn, ...)`` all add an edge
+  to ``fn`` (kind-tagged, so analyses can treat a spawn differently from a
+  direct call);
+- thread roles: a ``Thread(target=fn)`` spawn site tags ``fn`` with a role
+  inferred from the target's name / ``name=`` kwarg (dispatcher, probe,
+  watchdog, server, gc, worker), used by the lock analysis to say *which*
+  thread a hazard runs on (docs/STATIC_ANALYSIS.md).
+
+Everything is keyed by dotted qualnames: ``pkg.mod.func`` or
+``pkg.mod.Class.method``, derived from the path relative to the repo root.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+__all__ = ["Repo", "build_repo", "FuncInfo", "ClassInfo", "ModuleInfo",
+           "CallSite"]
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+# Thread-role inference: first matching substring of the spawn target's
+# name (or the Thread name= kwarg) wins.
+_ROLE_PATTERNS = (
+    ("dispatch", "dispatcher"),
+    ("watchdog", "watchdog"),
+    ("probe", "probe"),
+    ("serve", "server"),
+    ("gc", "gc"),
+    ("scrape", "scraper"),
+)
+
+
+def _dotted(node: ast.expr) -> str | None:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _annotation_name(node: ast.expr | None) -> str | None:
+    """Class name from a simple annotation: Name, dotted, 'X | None',
+    Optional[X], or a string literal of any of those."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        for side in (node.left, node.right):
+            n = _annotation_name(side)
+            if n is not None and n != "None":
+                return n
+        return None
+    if isinstance(node, ast.Subscript):
+        base = _dotted(node.value)
+        if base in ("Optional", "typing.Optional"):
+            return _annotation_name(node.slice)
+        return None
+    return _dotted(node)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qual: str                      # pkg.mod.func or pkg.mod.Class.method
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    module: "ModuleInfo"
+    cls: "ClassInfo | None" = None
+
+    @property
+    def path(self) -> str:
+        return self.module.path
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def params(self) -> list[str]:
+        a = self.node.args
+        out = [p.arg for p in a.posonlyargs + a.args]
+        out.extend(p.arg for p in a.kwonlyargs)
+        return out
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qual: str
+    name: str
+    node: ast.ClassDef
+    module: "ModuleInfo"
+    methods: dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    bases: list[str] = dataclasses.field(default_factory=list)  # quals
+    # attribute name -> repo class qual (self-type inference)
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    # lock attribute -> ctor kind ("Lock" | "RLock" | "Condition")
+    lock_attrs: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    qual: str                      # dotted module name
+    path: str
+    tree: ast.Module
+    imports: dict[str, str] = dataclasses.field(default_factory=dict)
+    functions: dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    classes: dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    # module-level lock name -> ctor kind
+    lock_globals: dict[str, str] = dataclasses.field(default_factory=dict)
+    # module-level `x = ClassName(...)` instance bindings -> class qual
+    instance_globals: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: str                    # qual of the enclosing function
+    callee: str                    # resolved qual
+    line: int
+    col: int
+    kind: str                      # "call" | "jit" | "thread" | "executor" | "partial"
+    node: ast.AST
+
+
+class Repo:
+    """Parsed modules + the resolved call graph."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.calls: dict[str, list[CallSite]] = {}   # caller qual -> sites
+        self.callers: dict[str, list[CallSite]] = {} # callee qual -> sites
+        self.thread_roles: dict[str, str] = {}       # func qual -> role
+        self._mod_strs: dict[str, set[str]] = {}     # module qual -> literals
+        # memo for _local_instance_types: the result depends only on the
+        # function body and the (immutable after build) import tables, but
+        # the dataflow fixpoint re-evaluates functions many times
+        self._local_types_memo: dict[int, dict[str, str]] = {}
+        self._walk_memo: dict[int, list[ast.AST]] = {}
+
+    def walk_list(self, node: "ast.AST") -> "list[ast.AST]":
+        """Flat ast.walk order of `node`, cached — several passes scan every
+        function body and the trees never change after build."""
+        got = self._walk_memo.get(id(node))
+        if got is None:
+            got = list(ast.walk(node))
+            self._walk_memo[id(node)] = got
+        return got
+
+    # -- symbol resolution ---------------------------------------------------
+
+    def resolve_symbol(self, module: ModuleInfo, name: str,
+                       _depth: int = 0) -> str | None:
+        """Resolve a dotted name used inside `module` to a repo qual
+        (function, class, or module), following imports and one level of
+        package re-export."""
+        if _depth > 4:
+            return None
+        head, _, rest = name.partition(".")
+        target: str | None = None
+        if head in module.functions:
+            target = module.functions[head].qual
+        elif head in module.classes:
+            target = module.classes[head].qual
+        elif head in module.imports:
+            target = module.imports[head]
+        elif head in module.instance_globals:
+            # module-level singleton instance: method access on it
+            target = module.instance_globals[head]
+        elif module.qual + "." + head in self.modules:
+            target = module.qual + "." + head
+        if target is None:
+            return None
+        qual = target + ("." + rest if rest else "")
+        return self._canonical(qual, _depth)
+
+    def _canonical(self, qual: str, _depth: int = 0) -> str | None:
+        """Normalize a candidate qual to something the repo defines:
+        a module, class, function, or method qual — following package
+        __init__ re-exports."""
+        if qual in self.functions or qual in self.classes \
+                or qual in self.modules:
+            return qual
+        # Class.method / module.symbol
+        base, _, leaf = qual.rpartition(".")
+        if not base:
+            return None
+        if base in self.classes:
+            cls = self.classes[base]
+            m = self._find_method(cls, leaf)
+            return m.qual if m is not None else qual
+        if base in self.modules:
+            mod = self.modules[base]
+            if leaf in mod.functions:
+                return mod.functions[leaf].qual
+            if leaf in mod.classes:
+                return mod.classes[leaf].qual
+            if leaf in mod.imports:   # package re-export
+                return self._canonical(mod.imports[leaf], _depth + 1)
+            return None
+        # parent might itself need canonicalization (pkg re-export chains)
+        parent = self._canonical(base, _depth + 1)
+        if parent is not None and parent != base and _depth < 4:
+            return self._canonical(parent + "." + leaf, _depth + 1)
+        return None
+
+    def _find_method(self, cls: ClassInfo, name: str,
+                     _depth: int = 0) -> FuncInfo | None:
+        if name in cls.methods:
+            return cls.methods[name]
+        if _depth > 3:
+            return None
+        for b in cls.bases:
+            base = self.classes.get(b)
+            if base is not None:
+                m = self._find_method(base, name, _depth + 1)
+                if m is not None:
+                    return m
+        return None
+
+    def class_of(self, fn: FuncInfo) -> ClassInfo | None:
+        return fn.cls
+
+    # -- receiver-type inference ---------------------------------------------
+
+    def _local_instance_types(self, fn: FuncInfo) -> dict[str, str]:
+        """var name -> class qual for `v = ClassName(...)` bindings and
+        annotated parameters inside `fn`."""
+        cached = self._local_types_memo.get(id(fn.node))
+        if cached is not None:
+            return cached
+        out: dict[str, str] = {}
+        args = fn.node.args
+        for p in args.posonlyargs + args.args + args.kwonlyargs:
+            ann = _annotation_name(p.annotation)
+            if ann:
+                q = self.resolve_symbol(fn.module, ann)
+                if q in self.classes:
+                    out[p.arg] = q
+        for node in self.walk_list(fn.node):
+            if isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                ann = _annotation_name(node.annotation)
+                if ann:
+                    q = self.resolve_symbol(fn.module, ann)
+                    if q in self.classes:
+                        out[node.target.id] = q
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            ctor = _dotted(node.value.func)
+            if ctor is None:
+                continue
+            q = self.resolve_symbol(fn.module, ctor)
+            if q in self.classes:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = q
+        self._local_types_memo[id(fn.node)] = out
+        return out
+
+    def receiver_class(self, fn: FuncInfo, expr: ast.expr,
+                       local_types: dict[str, str] | None = None
+                       ) -> ClassInfo | None:
+        """Class of the object `expr` evaluates to, when inferable:
+        `self`, `self.attr` (attr_types), a typed local/param, or a
+        module-level singleton."""
+        if local_types is None:
+            local_types = {}
+        if isinstance(expr, ast.Name):
+            selfname = fn.params()[0] if (fn.cls and fn.params()) else None
+            if expr.id == selfname and fn.cls is not None:
+                return fn.cls
+            q = local_types.get(expr.id)
+            if q is None:
+                q = fn.module.instance_globals.get(expr.id)
+            return self.classes.get(q) if q else None
+        if isinstance(expr, ast.Attribute):
+            base_cls = self.receiver_class(fn, expr.value, local_types)
+            if base_cls is not None:
+                q = base_cls.attr_types.get(expr.attr)
+                if q:
+                    return self.classes.get(q)
+                return None
+            # module attr: mod.SINGLETON
+            dotted = _dotted(expr)
+            if dotted:
+                q = self.resolve_symbol(fn.module, dotted)
+                if q in self.classes:
+                    return None  # a class object, not an instance
+        return None
+
+    # -- call resolution -----------------------------------------------------
+
+    def resolve_call(self, fn: FuncInfo, call: ast.Call,
+                     local_types: dict[str, str]) -> list[tuple[str, str]]:
+        """-> [(callee qual, kind)] for a Call node inside `fn`.  Includes
+        constructor edges (to Class.__init__ when defined) and first-order
+        callback edges found in the arguments."""
+        out: list[tuple[str, str]] = []
+        f = call.func
+        callee: str | None = None
+        if isinstance(f, ast.Name):
+            callee = self.resolve_symbol(fn.module, f.id)
+        elif isinstance(f, ast.Attribute):
+            recv = self.receiver_class(fn, f.value, local_types)
+            if recv is not None:
+                m = self._find_method(recv, f.attr)
+                if m is not None:
+                    callee = m.qual
+            else:
+                dotted = _dotted(f)
+                if dotted is not None:
+                    callee = self.resolve_symbol(fn.module, dotted)
+        if callee is not None:
+            if callee in self.classes:
+                init = self._find_method(self.classes[callee], "__init__")
+                out.append((init.qual if init else callee, "call"))
+            elif callee in self.functions:
+                out.append((callee, "call"))
+        out.extend(self._dispatch_edges(fn, call, local_types))
+        out.extend(self._callback_edges(fn, call, local_types))
+        return out
+
+    def _module_strings(self, mod: ModuleInfo) -> set[str]:
+        cached = self._mod_strs.get(mod.qual)
+        if cached is None:
+            cached = {n.value for n in ast.walk(mod.tree)
+                      if isinstance(n, ast.Constant)
+                      and isinstance(n.value, str)}
+            self._mod_strs[mod.qual] = cached
+        return cached
+
+    def _dispatch_edges(self, fn: FuncInfo, call: ast.Call,
+                        local_types: dict[str, str]
+                        ) -> list[tuple[str, str]]:
+        """Constant-string-table dispatch: `getattr(obj, name)(...)` where
+        the receiver's class is known resolves to every method of that
+        class whose name appears as a string literal in the module — the
+        route-table idiom (`_ROUTES = [..., "handler_name"]`)."""
+        f = call.func
+        if not (isinstance(f, ast.Call) and isinstance(f.func, ast.Name)
+                and f.func.id == "getattr" and len(f.args) >= 2):
+            return []
+        recv = self.receiver_class(fn, f.args[0], local_types)
+        if recv is None:
+            return []
+        if isinstance(f.args[1], ast.Constant) and isinstance(
+                f.args[1].value, str):
+            names: set[str] = {f.args[1].value}
+        else:
+            names = self._module_strings(fn.module)
+        out = []
+        for name, m in recv.methods.items():
+            if name in names:
+                out.append((m.qual, "call"))
+        return out
+
+    def _callback_edges(self, fn: FuncInfo, call: ast.Call,
+                        local_types: dict[str, str]
+                        ) -> list[tuple[str, str]]:
+        """jax.jit(f) / Thread(target=f) / pool.submit(f, ...) /
+        partial(f, ...) edges from a call's arguments."""
+        name = _dotted(call.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        out: list[tuple[str, str]] = []
+
+        def target_qual(expr: ast.expr) -> str | None:
+            if isinstance(expr, ast.Name):
+                return self.resolve_symbol(fn.module, expr.id)
+            if isinstance(expr, ast.Attribute):
+                recv = self.receiver_class(fn, expr.value, local_types)
+                if recv is not None:
+                    m = self._find_method(recv, expr.attr)
+                    if m is not None:
+                        return m.qual
+                dotted = _dotted(expr)
+                return self.resolve_symbol(fn.module, dotted) if dotted else None
+            return None
+
+        if leaf in ("jit",) and call.args:
+            q = target_qual(call.args[0])
+            if q in self.functions:
+                out.append((q, "jit"))
+        elif leaf in ("Thread",):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    q = target_qual(kw.value)
+                    if q in self.functions:
+                        out.append((q, "thread"))
+        elif leaf in ("submit", "apply_async", "map") and call.args:
+            q = target_qual(call.args[0])
+            if q in self.functions:
+                out.append((q, "executor"))
+        elif leaf in ("partial",) and call.args:
+            q = target_qual(call.args[0])
+            if q in self.functions:
+                out.append((q, "partial"))
+        return out
+
+
+# -- building ----------------------------------------------------------------
+
+def _module_qual(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    rel = rel.replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.strip("/").replace("/", ".")
+
+
+def _collect_imports(mod: ModuleInfo) -> None:
+    pkg_parts = mod.qual.split(".")
+    is_pkg = mod.path.replace("\\", "/").endswith("/__init__.py")
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                mod.imports[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative: level 1 from a package = the package itself;
+                # from a module = the containing package
+                up = node.level - (1 if is_pkg else 0)
+                base_parts = pkg_parts[: len(pkg_parts) - up] if up else pkg_parts
+                base = ".".join(base_parts)
+                if node.module:
+                    base = base + "." + node.module if base else node.module
+            else:
+                base = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                mod.imports[local] = (base + "." + alias.name) if base else alias.name
+
+
+def _is_lock_ctor(node: ast.expr) -> str | None:
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return _LOCK_CTORS.get(name or "")
+
+
+def _index_module(repo: Repo, mod: ModuleInfo) -> None:
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = FuncInfo(mod.qual + "." + node.name, node, mod)
+            mod.functions[node.name] = fi
+            repo.functions[fi.qual] = fi
+        elif isinstance(node, ast.ClassDef):
+            ci = ClassInfo(mod.qual + "." + node.name, node.name, node, mod)
+            mod.classes[node.name] = ci
+            repo.classes[ci.qual] = ci
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(ci.qual + "." + sub.name, sub, mod, ci)
+                    ci.methods[sub.name] = fi
+                    repo.functions[fi.qual] = fi
+        elif isinstance(node, ast.Assign):
+            kind = _is_lock_ctor(node.value)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if kind:
+                    mod.lock_globals[t.id] = kind
+
+
+def _link_module(repo: Repo, mod: ModuleInfo) -> None:
+    """Second pass (all modules indexed): resolve bases, attr types,
+    module-level instances."""
+    for ci in mod.classes.values():
+        for b in ci.node.bases:
+            name = _dotted(b)
+            if name:
+                q = repo.resolve_symbol(mod, name)
+                if q in repo.classes:
+                    ci.bases.append(q)
+        init = ci.methods.get("__init__")
+        ann_params: dict[str, str] = {}
+        if init is not None:
+            args = init.node.args
+            for p in args.posonlyargs + args.args + args.kwonlyargs:
+                ann = _annotation_name(p.annotation)
+                if ann:
+                    q = repo.resolve_symbol(mod, ann)
+                    if q in repo.classes:
+                        ann_params[p.arg] = q
+        for m in ci.methods.values():
+            params = m.params()
+            selfname = params[0] if params else None
+            if selfname is None:
+                continue
+            for node in ast.walk(m.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == selfname):
+                        continue
+                    kind = _is_lock_ctor(value) if value is not None else None
+                    if kind:
+                        ci.lock_attrs[t.attr] = kind
+                        continue
+                    q: str | None = None
+                    if isinstance(value, ast.Call):
+                        ctor = _dotted(value.func)
+                        if ctor:
+                            cand = repo.resolve_symbol(mod, ctor)
+                            if cand in repo.classes:
+                                q = cand
+                    elif isinstance(value, ast.Name):
+                        q = ann_params.get(value.id)
+                    if q is None and isinstance(node, ast.AnnAssign):
+                        ann = _annotation_name(node.annotation)
+                        if ann:
+                            cand = repo.resolve_symbol(mod, ann)
+                            if cand in repo.classes:
+                                q = cand
+                    if q is not None:
+                        ci.attr_types.setdefault(t.attr, q)
+    # module-level singletons: X = ClassName(...)
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = _dotted(node.value.func)
+            if not ctor:
+                continue
+            q = repo.resolve_symbol(mod, ctor)
+            if q in repo.classes:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mod.instance_globals[t.id] = q
+
+
+def _role_for(target_name: str, thread_name: str | None) -> str:
+    hay = (target_name + " " + (thread_name or "")).lower()
+    for pat, role in _ROLE_PATTERNS:
+        if pat in hay:
+            return role
+    return "worker"
+
+
+def _build_edges(repo: Repo) -> None:
+    for fi in list(repo.functions.values()):
+        local_types = repo._local_instance_types(fi)
+        seen: set[tuple[str, int, str]] = set()
+        for node in repo.walk_list(fi.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fi.node:
+                # nested defs belong to the enclosing function's frame for
+                # edge purposes (closures run on the same data), except
+                # they are also functions in their own right when named at
+                # module/class level — which nested ones are not.
+                pass
+            if not isinstance(node, ast.Call):
+                continue
+            for callee, kind in repo.resolve_call(fi, node, local_types):
+                key = (callee, node.lineno, kind)
+                if key in seen:
+                    continue
+                seen.add(key)
+                site = CallSite(fi.qual, callee, node.lineno,
+                                node.col_offset, kind, node)
+                repo.calls.setdefault(fi.qual, []).append(site)
+                repo.callers.setdefault(callee, []).append(site)
+                if kind == "thread":
+                    tname = None
+                    for kw in node.keywords:
+                        if kw.arg == "name" and isinstance(
+                                kw.value, ast.Constant):
+                            tname = str(kw.value.value)
+                    leaf = callee.rsplit(".", 1)[-1]
+                    role = _role_for(leaf, tname)
+                    prev = repo.thread_roles.get(callee)
+                    if prev is not None and prev != role:
+                        role = "worker"
+                    repo.thread_roles[callee] = role
+
+
+def _propagate_roles(repo: Repo) -> None:
+    """Push spawn roles down call edges: a function reached from exactly
+    one role keeps it; reached from several, it is shared ('worker')."""
+    from collections import deque
+
+    q = deque(repo.thread_roles.items())
+    while q:
+        qual, role = q.popleft()
+        for site in repo.calls.get(qual, ()):
+            if site.kind not in ("call", "partial"):
+                continue
+            cur = repo.thread_roles.get(site.callee)
+            if cur is None:
+                repo.thread_roles[site.callee] = role
+                q.append((site.callee, role))
+            elif cur != role and cur != "worker":
+                repo.thread_roles[site.callee] = "worker"
+                q.append((site.callee, "worker"))
+
+
+def build_repo(files: list[tuple[str, str]], root: str | None = None,
+               trees: "dict[str, ast.Module] | None" = None) -> Repo:
+    """Build the call graph.  `files` is [(path, source)]; `root` anchors
+    module qualnames (default: common root inferred as the parent of the
+    topmost package directory of each file).  `trees` maps path -> an
+    already-parsed module, sparing a second ast.parse of the same source."""
+    repo = Repo()
+    if root is None:
+        root = _infer_root(files)
+    for path, src in files:
+        tree = trees.get(path) if trees else None
+        if tree is None:
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError:
+                continue
+        mod = ModuleInfo(_module_qual(path, root), path, tree)
+        repo.modules[mod.qual] = mod
+        _collect_imports(mod)
+        _index_module(repo, mod)
+    for mod in repo.modules.values():
+        _link_module(repo, mod)
+    _build_edges(repo)
+    _propagate_roles(repo)
+    return repo
+
+
+def _infer_root(files: list[tuple[str, str]]) -> str:
+    """Parent directory of the topmost package: walk up from each file
+    while __init__.py is present, then take the most common parent."""
+    from collections import Counter
+
+    roots: Counter = Counter()
+    for path, _src in files:
+        d = os.path.dirname(os.path.abspath(path))
+        while os.path.exists(os.path.join(d, "__init__.py")):
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        roots[d] += 1
+    return roots.most_common(1)[0][0] if roots else os.getcwd()

@@ -1,0 +1,1361 @@
+"""Interprocedural dataflow over the call graph (janus_lint/callgraph.py).
+
+A worklist fixpoint computes a *summary* per function — which parameters
+flow to the return value, which parameters reach a sink inside the function
+or anything it calls — and three analysis families consume the summaries:
+
+- **secret-leak taint**: sources are HPKE private keys and derived key
+  schedule material, auth tokens, joint-rand/XOF seeds, VDAF verify keys,
+  and decrypted measurement shares (seeded from core/hpke, core/auth_tokens
+  and the vdaf/ signatures); sinks are logging calls, metric label values,
+  flight-recorder event payloads, RFC-7807 problem bodies / exception
+  constructor args, and artifact JSON.  Sanitizers (hashing, redaction,
+  length-only views) cut the flow.  Taint crosses calls through arguments,
+  return values, and container/f-string construction.
+
+- **retrace/host-sync hazards**: `len()` of per-request data is labelled a
+  request-varying size; the label survives arithmetic and helper returns
+  and fires when it reaches a ``static_argnums``/``static_argnames``
+  position of a jitted callable or a ``jnp`` shape constructor on the hot
+  path — unless a bucketing function (``bucket_size``/``bucket_floor``/
+  ``_grid_floor``/chunk planners) snapped it to the compile grid first
+  (``retrace-storm``).  Separately, per-function "reaches a host sync"
+  facts propagate up the graph so a hot-path call into a helper *outside*
+  engine/ops/vdaf that eventually blocks on the device is flagged at the
+  hot call site (``transitive-host-sync``) — the exact shape of hazard the
+  single-module jitpurity pass cannot see.
+
+- **whole-repo lock analysis**: per-function *may-acquire* (direct +
+  transitive through same-thread calls) and *must-hold* (the lock a
+  ``*_locked`` helper's body assumes) summaries.  Checks: a ``*_locked``
+  helper called without its lock held (``locked-helper-unheld``); a call
+  that re-acquires a non-reentrant lock the caller already holds — a
+  guaranteed self-deadlock (``lock-held-reacquire``); and lock-order
+  inversions whose edges only exist *through* calls, which the syntactic
+  per-module pass cannot see (``lock-order-cycle``).  Findings are tagged
+  with the thread role (dispatcher/probe/watchdog/...) of the code that
+  runs them, inferred from ``Thread(target=...)`` spawn sites.
+
+All findings are attributed to a concrete source line and are suppressible
+with the standard ``# janus-lint: disable=<rule> -- reason`` syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from janus_lint import Finding
+from janus_lint import callgraph
+from janus_lint.callgraph import FuncInfo, Repo
+
+__all__ = ["check_repo", "build_repo_from_files"]
+
+_HOT_DIRS = ("/engine/", "/ops/", "/vdaf/")
+
+
+def _is_hot(path: str) -> bool:
+    return any(d in path.replace("\\", "/") for d in _HOT_DIRS)
+
+
+def _dotted(node: ast.expr) -> str | None:
+    return callgraph._dotted(node)
+
+
+def _leaf(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# taint engine: labels, summaries, per-function abstract evaluation
+# ---------------------------------------------------------------------------
+
+# Labels are strings: "param:<i>" marks "derived from parameter i"; anything
+# else is an analysis-specific kind ("secret:key", "reqsize", ...).
+
+_PARAM = "param:"
+
+
+class Summary:
+    __slots__ = ("ret", "param_sinks")
+
+    def __init__(self) -> None:
+        self.ret: frozenset[str] = frozenset()
+        self.param_sinks: dict[int, str] = {}
+
+    def merge_ret(self, labels: set[str]) -> bool:
+        new = self.ret | labels
+        changed = new != self.ret
+        self.ret = frozenset(new)
+        return changed
+
+    def note_param_sink(self, i: int, desc: str) -> bool:
+        if i in self.param_sinks:
+            return False
+        self.param_sinks[i] = desc
+        return True
+
+
+class TaintSpec:
+    """Analysis-family hooks.  Subclasses define sources, sanitizers and
+    sinks; the engine owns propagation and the interprocedural fixpoint."""
+
+    rule = "secret-leak"
+
+    def param_source(self, fn: FuncInfo, pname: str) -> set[str]:
+        return set()
+
+    def attr_source(self, attr: str) -> set[str]:
+        return set()
+
+    def bleach_name(self, name: str) -> bool:
+        """Assignment targets with clearly-public names drop kind labels."""
+        return False
+
+    def call_kind_labels(self, fn: FuncInfo, qual: str | None, dotted: str,
+                         arg_labels: list[set[str]],
+                         call: ast.Call) -> set[str] | None:
+        """Kind labels for a call's return value, or None to defer to the
+        callee summary + generic propagation."""
+        return None
+
+    def is_sanitizer(self, qual: str | None, dotted: str) -> bool:
+        return False
+
+    def sinks(self, fn: FuncInfo, call: ast.Call
+              ) -> list[tuple[str, list[ast.expr]]]:
+        """[(sink description, [expressions that flow into the sink])]."""
+        return []
+
+    def raise_is_sink(self) -> bool:
+        return False
+
+    def interesting(self, labels: set[str]) -> bool:
+        """Whether any non-param label warrants a finding at a sink."""
+        return any(not l.startswith(_PARAM) for l in labels)
+
+    def describe(self, labels: set[str]) -> str:
+        kinds = sorted(l for l in labels if not l.startswith(_PARAM))
+        return "/".join(kinds)
+
+
+class _FnEval:
+    """One function's abstract evaluation.  Flow-insensitive per variable
+    (labels accumulate), two passes over the body so loops and
+    use-before-def converge.  When `findings` is given (report pass),
+    sink hits on interesting labels are emitted."""
+
+    def __init__(self, repo: Repo, spec: TaintSpec, fn: FuncInfo,
+                 summaries: dict[str, Summary],
+                 findings: list[Finding] | None = None):
+        self.repo = repo
+        self.spec = spec
+        self.fn = fn
+        self.summaries = summaries
+        self.findings = findings
+        self.summary = Summary()
+        self.env: dict[str, set[str]] = {}
+        self.attr_env: dict[str, set[str]] = {}
+        self.params = fn.params()
+        self.local_types = repo._local_instance_types(fn)
+        for i, p in enumerate(self.params):
+            labels = {_PARAM + str(i)} | spec.param_source(fn, p)
+            self.env[p] = labels
+
+    # -- driving -------------------------------------------------------------
+
+    def run(self) -> Summary:
+        for _ in range(2):
+            for st in self.fn.node.body:
+                self._stmt(st)
+        return self.summary
+
+    # -- statements ----------------------------------------------------------
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: evaluate its body in the same env (closures read
+            # the enclosing frame); its params are unknown -> empty labels
+            for p in st.args.args + st.args.posonlyargs + st.args.kwonlyargs:
+                self.env.setdefault(p.arg, set())
+            for sub in st.body:
+                self._stmt(sub)
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                self.summary.merge_ret(self._eval(st.value))
+            return
+        if isinstance(st, ast.Raise):
+            if st.exc is not None:
+                if isinstance(st.exc, ast.Call):
+                    # constructing the exception formats its args into
+                    # str(e) — a message sink; bare `raise err` re-raises
+                    # an existing object and formats nothing new
+                    labels: set[str] = set()
+                    for a in st.exc.args:
+                        labels |= self._eval(a)
+                    for kw in st.exc.keywords:
+                        labels |= self._eval(kw.value)
+                    self._eval(st.exc)
+                    if self.spec.raise_is_sink():
+                        self._hit_sink("exception message", labels, st)
+                else:
+                    self._eval(st.exc)
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            labels = self._eval(value) if value is not None else set()
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                self._assign(t, labels,
+                             aug=isinstance(st, ast.AugAssign))
+            return
+        if isinstance(st, ast.For):
+            labels = self._eval(st.iter)
+            self._assign(st.target, labels)
+            for sub in st.body + st.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                labels = self._eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, labels)
+            for sub in st.body:
+                self._stmt(sub)
+            return
+        if isinstance(st, ast.Expr):
+            self._eval(st.value)
+            return
+        if isinstance(st, ast.If):
+            self._eval(st.test)
+            for sub in st.body + st.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(st, ast.While):
+            self._eval(st.test)
+            for sub in st.body + st.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(st, ast.Try):
+            for sub in st.body + st.orelse + st.finalbody:
+                self._stmt(sub)
+            for h in st.handlers:
+                if h.name:
+                    self.env.setdefault(h.name, set())
+                for sub in h.body:
+                    self._stmt(sub)
+            return
+        if isinstance(st, (ast.Assert,)):
+            self._eval(st.test)
+            if st.msg is not None:
+                self._eval(st.msg)
+            return
+        if isinstance(st, ast.Delete):
+            return
+        # anything else: walk child statements / expressions generically
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(st, field, []) or []:
+                if isinstance(sub, ast.stmt):
+                    self._stmt(sub)
+        for field in ("value", "test", "exc", "msg"):
+            sub = getattr(st, field, None)
+            if isinstance(sub, ast.expr):
+                self._eval(sub)
+
+    def _assign(self, target: ast.expr, labels: set[str],
+                aug: bool = False) -> None:
+        if isinstance(target, ast.Name):
+            kept = labels
+            if self.spec.bleach_name(target.id):
+                kept = {l for l in labels if l.startswith(_PARAM)}
+            cur = self.env.setdefault(target.id, set())
+            cur |= kept
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign(el, labels)
+        elif isinstance(target, ast.Attribute):
+            # field-insensitive object model: self.x = v remembers labels
+            # for reads of self.x later in THIS function
+            self.attr_env.setdefault(target.attr, set()).update(labels)
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Name):
+                self.env.setdefault(base.id, set()).update(labels)
+            elif isinstance(base, ast.Attribute):
+                self.attr_env.setdefault(base.attr, set()).update(labels)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, labels)
+
+    # -- expressions ---------------------------------------------------------
+
+    def _eval(self, node: ast.expr) -> set[str]:
+        if isinstance(node, ast.Name):
+            labels = set(self.env.get(node.id, ()))
+            return labels
+        if isinstance(node, ast.Attribute):
+            # field-kind taint, not object-kind: reading a neutral field
+            # off a secret-holding container (task.min_batch_size off a
+            # task that also holds a keypair) is not a leak — kind labels
+            # attach to recognized field names, known-secret returns, and
+            # container/tuple flows, and a secret-named field read inside
+            # a helper is reported at the helper's own sink line
+            self._eval(node.value)
+            labels = set(self.spec.attr_source(node.attr))
+            labels |= self.attr_env.get(node.attr, set())
+            return labels
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            return self._eval(node.left) | self._eval(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out: set[str] = set()
+            for v in node.values:
+                out |= self._eval(v)
+            return out
+        if isinstance(node, ast.Compare):
+            self._eval(node.left)
+            for c in node.comparators:
+                self._eval(c)
+            return set()  # a boolean verdict carries no material
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test)
+            return self._eval(node.body) | self._eval(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for el in node.elts:
+                out |= self._eval(el)
+            return out
+        if isinstance(node, ast.Dict):
+            out = set()
+            for k in node.keys:
+                if k is not None:
+                    out |= self._eval(k)
+            for v in node.values:
+                out |= self._eval(v)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            out = set()
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    out |= self._eval(v.value)
+            return out
+        if isinstance(node, ast.FormattedValue):
+            return self._eval(node.value)
+        if isinstance(node, ast.Subscript):
+            labels = self._eval(node.value)
+            if isinstance(node.slice, ast.expr):
+                self._eval(node.slice)
+            return labels
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            for gen in node.generators:
+                self._assign(gen.target, self._eval(gen.iter))
+                for cond in gen.ifs:
+                    self._eval(cond)
+            if isinstance(node, ast.DictComp):
+                return self._eval(node.key) | self._eval(node.value)
+            return self._eval(node.elt)
+        if isinstance(node, ast.Lambda):
+            return set()
+        if isinstance(node, ast.Await):
+            return self._eval(node.value)
+        if isinstance(node, (ast.Constant, ast.Slice)):
+            return set()
+        # fallback: union over child expressions
+        out = set()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out |= self._eval(child)
+        return out
+
+    # -- calls ---------------------------------------------------------------
+
+    def _call(self, call: ast.Call) -> set[str]:
+        arg_labels = [self._eval(a) for a in call.args]
+        kw_labels = {kw.arg: self._eval(kw.value) for kw in call.keywords}
+        dotted = _dotted(call.func) or ""
+        if isinstance(call.func, ast.Attribute) and not dotted:
+            # method on a computed expression: evaluate the receiver
+            recv_labels = self._eval(call.func.value)
+        elif isinstance(call.func, ast.Attribute):
+            recv_labels = self._eval(call.func.value)
+        else:
+            recv_labels = set()
+
+        # sinks first (report pass)
+        for desc, exprs in self.spec.sinks(self.fn, call):
+            labels: set[str] = set()
+            for e in exprs:
+                labels |= self._eval(e)
+            self._hit_sink(desc, labels, call)
+
+        callees = self.repo.resolve_call(self.fn, call, self.local_types)
+        direct = [q for q, kind in callees if kind == "call"]
+
+        kind_labels = self.spec.call_kind_labels(
+            self.fn, direct[0] if direct else None, dotted, arg_labels, call)
+        if kind_labels is not None:
+            return kind_labels
+        if self.spec.is_sanitizer(direct[0] if direct else None, dotted):
+            return set()
+
+        out: set[str] = set()
+        resolved_fn = False
+        for qual in direct:
+            callee = self.repo.functions.get(qual)
+            if callee is None:
+                continue
+            resolved_fn = True
+            mapped = self._map_args(callee, call, arg_labels, kw_labels,
+                                    recv_labels)
+            summ = self.summaries.get(qual)
+            if summ is None:
+                continue
+            # propagate into our own summary: our params reaching the
+            # callee's sink-reaching params
+            for i, labels in mapped.items():
+                sink_desc = summ.param_sinks.get(i)
+                if sink_desc is None:
+                    continue
+                for l in labels:
+                    if l.startswith(_PARAM):
+                        pi = int(l[len(_PARAM):])
+                        self.summary.note_param_sink(
+                            pi, f"{sink_desc} via {callee.name}()")
+                if self.findings is not None and self.spec.interesting(labels):
+                    self._emit(call, sink_desc, labels,
+                               via=f"{callee.name}()")
+            # return labels: substitute param markers with this call's args
+            for l in summ.ret:
+                if l.startswith(_PARAM):
+                    i = int(l[len(_PARAM):])
+                    out |= mapped.get(i, set())
+                else:
+                    out.add(l)
+        if not resolved_fn:
+            # unresolved call: conservative pass-through of its inputs
+            for labels in arg_labels:
+                out |= labels
+            for labels in kw_labels.values():
+                out |= labels
+            out |= recv_labels
+        return out
+
+    def _map_args(self, callee: FuncInfo, call: ast.Call,
+                  arg_labels: list[set[str]],
+                  kw_labels: dict[str | None, set[str]],
+                  recv_labels: set[str]) -> dict[int, set[str]]:
+        """callee param index -> labels flowing in at this site."""
+        params = callee.params()
+        mapped: dict[int, set[str]] = {}
+        offset = 0
+        if callee.cls is not None and isinstance(call.func, ast.Attribute):
+            # instance/classmethod call: args shift past self/cls
+            offset = 1
+            if params and recv_labels:
+                mapped[0] = set(recv_labels)
+        for i, labels in enumerate(arg_labels):
+            if i + offset < len(params):
+                mapped.setdefault(i + offset, set()).update(labels)
+            elif params:
+                mapped.setdefault(len(params) - 1, set()).update(labels)
+        for name, labels in kw_labels.items():
+            if name is None:
+                for j in range(len(params)):
+                    mapped.setdefault(j, set()).update(labels)
+                continue
+            if name in params:
+                mapped.setdefault(params.index(name), set()).update(labels)
+        return mapped
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _hit_sink(self, desc: str, labels: set[str],
+                  node: ast.AST) -> None:
+        for l in labels:
+            if l.startswith(_PARAM):
+                self.summary.note_param_sink(int(l[len(_PARAM):]), desc)
+        if self.findings is not None and self.spec.interesting(labels):
+            self._emit(node, desc, labels)
+
+    def _emit(self, node: ast.AST, desc: str, labels: set[str],
+              via: str | None = None) -> None:
+        kinds = self.spec.describe(labels)
+        role = self.repo.thread_roles.get(self.fn.qual)
+        tail = f" [on the {role} thread]" if role else ""
+        via_s = f" through {via}" if via else ""
+        self.findings.append(Finding(
+            self.spec.rule, self.fn.path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0),
+            f"{kinds} reaches {desc}{via_s} in {self.fn.name}(){tail}"))
+
+
+def _fixpoint(repo: Repo, spec: TaintSpec,
+              quals: list[str]) -> dict[str, Summary]:
+    summaries: dict[str, Summary] = {q: Summary() for q in quals}
+    from collections import deque
+
+    work = deque(quals)
+    queued = set(quals)
+    rounds = 0
+    while work and rounds < 20000:
+        rounds += 1
+        qual = work.popleft()
+        queued.discard(qual)
+        fn = repo.functions[qual]
+        new = _FnEval(repo, spec, fn, summaries).run()
+        old = summaries[qual]
+        changed = (new.ret != old.ret
+                   or set(new.param_sinks) != set(old.param_sinks))
+        # merge (monotone): keep first sink description, grow ret
+        merged = Summary()
+        merged.ret = old.ret | new.ret
+        merged.param_sinks = {**new.param_sinks, **old.param_sinks}
+        summaries[qual] = merged
+        if changed:
+            for site in repo.callers.get(qual, ()):
+                if site.caller in summaries and site.caller not in queued:
+                    work.append(site.caller)
+                    queued.add(site.caller)
+    return summaries
+
+
+def _run_taint(repo: Repo, spec: TaintSpec) -> list[Finding]:
+    quals = list(repo.functions)
+    summaries = _fixpoint(repo, spec, quals)
+    findings: list[Finding] = []
+    for qual in quals:
+        fn = repo.functions[qual]
+        _FnEval(repo, spec, fn, summaries, findings).run()
+    # dedupe (two eval passes + fixpoint revisits repeat emissions)
+    seen: set[tuple] = set()
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# family (a): secret-leak taint
+# ---------------------------------------------------------------------------
+
+# identifiers that ARE secret material (exact or trailing-segment match)
+_SECRET_NAMES = {
+    "private_key": "secret:key", "sk": "secret:key",
+    "sk_bytes": "secret:key", "sk_r": "secret:key", "sk_e": "secret:key",
+    "shared_secret": "secret:key", "secret": "secret:key",
+    "prk": "secret:key", "ikm": "secret:key",
+    "verify_key": "secret:verify-key", "vk": "secret:verify-key",
+    "vks": "secret:verify-key",
+    "joint_rand_seed": "secret:seed",
+    "token": "secret:token", "bearer_token": "secret:token",
+    "auth_token": "secret:token",
+    "measurement": "secret:share", "measurements": "secret:share",
+    "plaintext": "secret:share", "plaintexts": "secret:share",
+}
+
+# names that mark clearly-public material: assignments to them drop kinds
+_PUBLIC_NAMES = {
+    "pk", "pk_bytes", "pk_r", "public", "public_key", "public_share",
+    "public_shares", "config", "configs", "enc", "encs", "nonce", "nonces",
+    "aad", "aads", "report_id", "task_id", "job_id", "n", "count", "size",
+    "status", "status_code", "ok", "backend", "kind", "name", "code",
+}
+
+# resolved-callee quals (suffix match) whose RETURN is secret material
+_SECRET_RETURNS = (
+    (".hpke.open_ciphertext", "secret:share"),
+    (".hpke.open_ciphertexts_batch", "secret:share"),
+    (".hpke.open_ciphertexts_batch_raw", "secret:share"),
+    (".hpke.open_ciphertexts_grouped", "secret:share"),
+    ("._hkdf_extract", "secret:key"),
+    ("._hkdf_expand", "secret:key"),
+    ("._labeled_extract", "secret:key"),
+    ("._labeled_expand", "secret:key"),
+    ("._key_and_nonce", "secret:key"),
+    ("Kem.decap", "secret:key"),
+    ("Kem.encap", "secret:key"),
+    ("Kem._dh", "secret:key"),
+    ("Kem._extract_and_expand", "secret:key"),
+    ("HpkeKeypair.generate", "secret:key"),
+    (".hpke.generate_hpke_config_and_private_key", "secret:key"),
+    ("AuthenticationToken.random_bearer", "secret:token"),
+    ("AuthenticationToken.random_dap_auth", "secret:token"),
+    (".auth_tokens.extract_bearer_token", "secret:token"),
+)
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_METRIC_METHODS = {"inc", "observe", "set"}
+_SANITIZER_LEAVES = {
+    "len", "bool", "isinstance", "type", "id", "hash", "compare_digest",
+    "sha256", "sha384", "sha512", "sha1", "md5", "blake2b", "blake2s",
+    "range", "enumerate",
+}
+_SANITIZER_SUBSTR = ("redact", "fingerprint", "tokenhash")
+
+
+def _name_kind(name: str) -> str | None:
+    low = name.lower()
+    if low in _SECRET_NAMES:
+        return _SECRET_NAMES[low]
+    segs = low.split("_")
+    if len(segs) > 1 and segs[-1] in ("seed", "token", "key") \
+            and segs[-1] != low:
+        # *_seed / *_token secrets, but metadata tails stay exempt
+        if segs[-1] == "key" and segs[-2] in ("public",):
+            return None
+        return {"seed": "secret:seed", "token": "secret:token",
+                "key": "secret:key"}[segs[-1]]
+    return None
+
+
+class SecretLeakSpec(TaintSpec):
+    rule = "secret-leak"
+
+    def param_source(self, fn: FuncInfo, pname: str) -> set[str]:
+        kind = _name_kind(pname)
+        return {kind} if kind else set()
+
+    def attr_source(self, attr: str) -> set[str]:
+        kind = _name_kind(attr)
+        return {kind} if kind else set()
+
+    def bleach_name(self, name: str) -> bool:
+        return name.lower() in _PUBLIC_NAMES
+
+    def call_kind_labels(self, fn: FuncInfo, qual: str | None, dotted: str,
+                         arg_labels: list[set[str]],
+                         call: ast.Call) -> set[str] | None:
+        if qual:
+            for suffix, kind in _SECRET_RETURNS:
+                if qual.endswith(suffix):
+                    return {kind}
+        return None
+
+    def is_sanitizer(self, qual: str | None, dotted: str) -> bool:
+        leaf = _leaf(dotted).lower()
+        if leaf in _SANITIZER_LEAVES:
+            return True
+        if any(s in leaf for s in _SANITIZER_SUBSTR):
+            return True
+        head = dotted.split(".")[0].lower()
+        if head in ("hashlib",):
+            return True
+        if leaf == "of" and "tokenhash" in dotted.lower():
+            return True
+        if qual and _leaf(qual) == "of" and "TokenHash" in qual:
+            return True
+        return False
+
+    def sinks(self, fn: FuncInfo, call: ast.Call
+              ) -> list[tuple[str, list[ast.expr]]]:
+        f = call.func
+        out: list[tuple[str, list[ast.expr]]] = []
+        dotted = _dotted(f) or ""
+        leaf = _leaf(dotted)
+        if isinstance(f, ast.Attribute):
+            base = _dotted(f.value) or ""
+            base_leaf = _leaf(base).lower()
+            if f.attr in _LOG_METHODS and (
+                    "log" in base_leaf or base_leaf == "logging"):
+                exprs = list(call.args) + [
+                    kw.value for kw in call.keywords
+                    if kw.arg not in ("exc_info", "stack_info", "stacklevel")]
+                out.append(("a log line", exprs))
+            elif f.attr == "record" and (
+                    "record" in base_leaf or "flight" in base_leaf
+                    or base.endswith("flight_recorder")):
+                exprs = list(call.args) + [kw.value for kw in call.keywords]
+                out.append(("a flight-recorder event", exprs))
+            elif f.attr in _METRIC_METHODS and call.keywords:
+                exprs = [kw.value for kw in call.keywords if kw.arg]
+                if exprs:
+                    out.append(("a metric label value", exprs))
+        if dotted in ("json.dump", "json.dumps") and call.args:
+            out.append(("serialized artifact JSON", [call.args[0]]))
+        if leaf in ("Finding",):
+            pass
+        return out
+
+    def raise_is_sink(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# family (b1): retrace-storm
+# ---------------------------------------------------------------------------
+
+_BUCKET_SUBSTR = ("bucket", "grid_floor", "chunk_plan", "pad_to", "round_up")
+_JNP_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange",
+                    "broadcast_to"}
+
+# names of per-request Python collections whose len() is a retrace hazard —
+# len() of a device array inside a shape-polymorphic kernel is static per
+# trace and NOT labelled (the entry points bucket; flagging every kernel's
+# jnp.zeros(len(x)) would only restate "jit compiles per shape")
+_REQ_COLLECTIONS = {
+    "report", "reports", "share", "shares", "ciphertext", "ciphertexts",
+    "cts", "ct", "encs", "payloads", "measurements", "uploads", "nonces",
+    "prepares", "prepare_inits", "rejections", "entries", "items", "jobs",
+    "requests", "batch", "chunks", "lanes_in", "group", "groups",
+}
+
+
+def _leaf_name(expr: ast.expr) -> str | None:
+    """The identifier a len() argument reads: `x`, `obj.x`, `x[0]` -> x."""
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _jit_wrappers(mod_tree: ast.Module) -> dict[str, tuple[set[int], set[str]]]:
+    """name -> (static_argnums, static_argnames) for `X = jax.jit(f, ...)`
+    and `self.X = jax.jit(f, ...)` bindings in this module."""
+    out: dict[str, tuple[set[int], set[str]]] = {}
+    for node in ast.walk(mod_tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call) and _dotted(v.func) in
+                ("jax.jit", "jit")):
+            continue
+        nums: set[int] = set()
+        names: set[str] = set()
+        for kw in v.keywords:
+            if kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, int):
+                        nums.add(sub.value)
+            elif kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str):
+                        names.add(sub.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = (nums, names)
+            elif isinstance(t, ast.Attribute):
+                out[t.attr] = (nums, names)
+    return out
+
+
+class RetraceSpec(TaintSpec):
+    rule = "retrace-storm"
+
+    def __init__(self, repo: Repo):
+        self._wrappers: dict[str, dict[str, tuple[set[int], set[str]]]] = {}
+        for mod in repo.modules.values():
+            self._wrappers[mod.qual] = _jit_wrappers(mod.tree)
+
+    def call_kind_labels(self, fn: FuncInfo, qual: str | None, dotted: str,
+                         arg_labels: list[set[str]],
+                         call: ast.Call) -> set[str] | None:
+        leaf = _leaf(dotted)
+        if leaf == "len" and call.args:
+            name = _leaf_name(call.args[0])
+            if name is not None and name.lower() in _REQ_COLLECTIONS:
+                return {"reqsize"}
+            return set()
+        return None
+
+    def is_sanitizer(self, qual: str | None, dotted: str) -> bool:
+        leaf = _leaf(dotted).lower()
+        return any(s in leaf for s in _BUCKET_SUBSTR)
+
+    def sinks(self, fn: FuncInfo, call: ast.Call
+              ) -> list[tuple[str, list[ast.expr]]]:
+        out: list[tuple[str, list[ast.expr]]] = []
+        f = call.func
+        dotted = _dotted(f) or ""
+        leaf = _leaf(dotted)
+        head = dotted.split(".")[0]
+        # jnp shape constructors on the hot path
+        if head in ("jnp",) and leaf in _JNP_SHAPE_CTORS \
+                and _is_hot(fn.path) and call.args:
+            out.append((f"the device array shape of jnp.{leaf}()",
+                        [call.args[0]]))
+        # static positions of a jit-wrapped callable
+        wrappers = self._wrappers.get(fn.module.qual, {})
+        wname = None
+        if isinstance(f, ast.Name):
+            wname = f.id
+        elif isinstance(f, ast.Attribute):
+            wname = f.attr
+        if wname in wrappers:
+            nums, names = wrappers[wname]
+            exprs = [a for i, a in enumerate(call.args) if i in nums]
+            exprs += [kw.value for kw in call.keywords if kw.arg in names]
+            if exprs:
+                out.append((f"a static jit key of {wname}()", exprs))
+        return out
+
+    def interesting(self, labels: set[str]) -> bool:
+        return "reqsize" in labels
+
+    def describe(self, labels: set[str]) -> str:
+        return "a per-request Python size (unbucketed)"
+
+
+# ---------------------------------------------------------------------------
+# family (b2): transitive host sync
+# ---------------------------------------------------------------------------
+
+_SYNC_ATTRS = {"item", "block_until_ready"}
+
+
+def _own_syncs(fn: FuncInfo, jitted_ids: set[int],
+               nodes: "list[ast.AST] | None" = None) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    if id(fn.node) in jitted_ids:
+        return out
+    for node in (nodes if nodes is not None else ast.walk(fn.node)):
+        if id(node) in jitted_ids:
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS \
+                and not node.args:
+            out.append((node.lineno, f".{f.attr}()"))
+        elif _dotted(f) in ("jax.device_get", "jax.block_until_ready"):
+            out.append((node.lineno, f"{_dotted(f)}()"))
+    return out
+
+
+def _check_transitive_sync(repo: Repo) -> list[Finding]:
+    from janus_lint import jitpurity
+
+    jitted_ids: set[int] = set()
+    for mod in repo.modules.values():
+        for fn_node, _nums, _names in jitpurity._jitted_defs(mod.tree).values():
+            jitted_ids.update(id(sub) for sub in ast.walk(fn_node))
+
+    # (path, line, chain) per function that reaches a sync
+    reach: dict[str, tuple[str, int, str, tuple[str, ...]]] = {}
+    for qual, fn in repo.functions.items():
+        syncs = _own_syncs(fn, jitted_ids, repo.walk_list(fn.node))
+        if syncs:
+            line, desc = syncs[0]
+            reach[qual] = (fn.path, line, desc, (fn.name,))
+    changed = True
+    depth = 0
+    while changed and depth < 12:
+        changed = False
+        depth += 1
+        for qual, fn in repo.functions.items():
+            if qual in reach:
+                continue
+            for site in repo.calls.get(qual, ()):
+                if site.kind not in ("call", "partial"):
+                    continue
+                hit = reach.get(site.callee)
+                if hit is not None:
+                    path, line, desc, chain = hit
+                    reach[qual] = (path, line, desc,
+                                   (fn.name,) + chain[:4])
+                    changed = True
+                    break
+
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    for qual, fn in repo.functions.items():
+        if not _is_hot(fn.path):
+            continue
+        if id(fn.node) in jitted_ids:
+            continue
+        for site in repo.calls.get(qual, ()):
+            if site.kind != "call":
+                continue
+            callee = repo.functions.get(site.callee)
+            if callee is None or _is_hot(callee.path):
+                continue  # in-hot-dir syncs are the syntactic pass's job
+            hit = reach.get(site.callee)
+            if hit is None:
+                continue
+            path, line, desc, chain = hit
+            key = (fn.path, site.line, site.callee)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "transitive-host-sync", fn.path, site.line, site.col,
+                f"hot-path call {callee.name}() reaches a blocking host "
+                f"sync {desc} at {path}:{line} "
+                f"(via {' -> '.join(chain)})"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# family (c): whole-repo lock analysis
+# ---------------------------------------------------------------------------
+
+class _LockWorld:
+    """Lock identities, per-class guarded registries, and per-function
+    acquire/require summaries."""
+
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        # lock id -> ctor kind ("Lock"|"RLock"|"Condition")
+        self.kinds: dict[str, str] = {}
+        for ci in repo.classes.values():
+            for attr, kind in ci.lock_attrs.items():
+                self.kinds[f"{ci.qual}.{attr}"] = kind
+        for mod in repo.modules.values():
+            for name, kind in mod.lock_globals.items():
+                self.kinds[f"{mod.qual}.{name}"] = kind
+        self.guarded: dict[str, dict[str, set[str]]] = {}  # class -> attr -> locks
+        self.direct: dict[str, set[str]] = {}
+        self.may: dict[str, set[str]] = {}
+        self.requires: dict[str, set[str]] = {}
+        self.edges: list[tuple[str, str, str, int, bool]] = []
+        # (outer, inner, path, line, interprocedural)
+
+    # lock id for a with-item context expression, if resolvable
+    def lock_id(self, fn: FuncInfo, expr: ast.expr,
+                local_types: dict[str, str]) -> str | None:
+        if isinstance(expr, ast.Name):
+            if expr.id in fn.module.lock_globals:
+                return f"{fn.module.qual}.{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            recv = self.repo.receiver_class(fn, expr.value, local_types)
+            if recv is not None and expr.attr in recv.lock_attrs:
+                return f"{recv.qual}.{expr.attr}"
+            dotted = _dotted(expr)
+            if dotted and "." in dotted:
+                base, leaf = dotted.rsplit(".", 1)
+                q = self.repo.resolve_symbol(fn.module, base)
+                if q in self.repo.modules \
+                        and leaf in self.repo.modules[q].lock_globals:
+                    return f"{q}.{leaf}"
+        return None
+
+
+def _walk_held(world: _LockWorld, fn: FuncInfo, held0: frozenset,
+               on_call, on_edge) -> None:
+    """Visit every Call with the set of lock ids held at that point;
+    report with-nesting edges via on_edge(outer, inner, node)."""
+    local_types = world.repo._local_instance_types(fn)
+
+    def visit(st, held: frozenset):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in st.body:
+                visit(sub, frozenset())  # closures escape the section
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in st.items:
+                lid = world.lock_id(fn, item.context_expr, local_types)
+                if lid is not None:
+                    acquired.append(lid)
+                    for h in held:
+                        on_edge(h, lid, st)
+                scan_calls(item.context_expr, held)
+            new_held = held | frozenset(acquired)
+            for sub in st.body:
+                visit(sub, new_held)
+            return
+        # generic: scan this statement's own expressions, then child stmts
+        for field, value in ast.iter_fields(st):
+            if isinstance(value, ast.expr):
+                scan_calls(value, held)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        visit(v, held)
+                    elif isinstance(v, ast.expr):
+                        scan_calls(v, held)
+                    elif isinstance(v, ast.excepthandler):
+                        for sub in v.body:
+                            visit(sub, held)
+
+    def scan_calls(expr: ast.expr, held: frozenset):
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Call):
+                on_call(node, held)
+
+    for st in fn.node.body:
+        visit(st, held0)
+
+
+def _build_lock_world(repo: Repo) -> _LockWorld:
+    world = _LockWorld(repo)
+
+    # guarded registries per class (attr written under a class lock)
+    for ci in repo.classes.values():
+        if not ci.lock_attrs:
+            continue
+        guarded: dict[str, set[str]] = {}
+        for m in ci.methods.values():
+            params = m.params()
+            selfname = params[0] if params else None
+            if selfname is None:
+                continue
+
+            def on_call(node, held):
+                pass
+
+            def on_edge(outer, inner, node):
+                pass
+
+            # writes under locks: custom scan
+            local_types = repo._local_instance_types(m)
+
+            def scan(st, held):
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    acq = []
+                    for item in st.items:
+                        lid = world.lock_id(m, item.context_expr, local_types)
+                        if lid is not None and lid.startswith(ci.qual + "."):
+                            acq.append(lid.rsplit(".", 1)[-1])
+                    new = held | set(acq)
+                    for sub in st.body:
+                        scan(sub, new)
+                    return
+                if held and isinstance(st, (ast.Assign, ast.AugAssign,
+                                            ast.AnnAssign)):
+                    targets = (st.targets if isinstance(st, ast.Assign)
+                               else [st.target])
+                    for t in targets:
+                        tt = t
+                        if isinstance(tt, ast.Subscript):
+                            tt = tt.value
+                        if isinstance(tt, ast.Attribute) and isinstance(
+                                tt.value, ast.Name) and tt.value.id == selfname:
+                            guarded.setdefault(tt.attr, set()).update(held)
+                if held and isinstance(st, ast.Expr) and isinstance(
+                        st.value, ast.Call):
+                    f = st.value.func
+                    if isinstance(f, ast.Attribute) and isinstance(
+                            f.value, ast.Attribute) and isinstance(
+                                f.value.value, ast.Name) \
+                            and f.value.value.id == selfname:
+                        guarded.setdefault(f.value.attr, set()).update(held)
+                for field, value in ast.iter_fields(st):
+                    if isinstance(value, list):
+                        for v in value:
+                            if isinstance(v, ast.stmt):
+                                scan(v, held)
+                            elif isinstance(v, ast.excepthandler):
+                                for sub in v.body:
+                                    scan(sub, held)
+
+            for st in m.node.body:
+                scan(st, set())
+        for lock in ci.lock_attrs:
+            guarded.pop(lock, None)
+        world.guarded[ci.qual] = guarded
+
+    # direct acquires + syntactic nesting edges
+    for qual, fn in repo.functions.items():
+        acquired: set[str] = set()
+
+        def on_call(node, held):
+            pass
+
+        def on_edge(outer, inner, node, _fn=fn):
+            world.edges.append((outer, inner, _fn.path, node.lineno, False))
+
+        def on_call2(node, held):
+            pass
+
+        local_types = repo._local_instance_types(fn)
+
+        def collect(st):
+            for node in repo.walk_list(st):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        lid = world.lock_id(fn, item.context_expr,
+                                            local_types)
+                        if lid is not None:
+                            acquired.add(lid)
+
+        collect(fn.node)
+        world.direct[qual] = acquired
+        _walk_held(world, fn, frozenset(), on_call, on_edge)
+
+    # requires: *_locked helpers assume their class lock(s)
+    for qual, fn in repo.functions.items():
+        if not fn.name.endswith("_locked") or fn.cls is None:
+            continue
+        guarded = world.guarded.get(fn.cls.qual, {})
+        req: set[str] = set()
+        params = fn.params()
+        selfname = params[0] if params else None
+        if selfname is not None:
+            for node in repo.walk_list(fn.node):
+                if isinstance(node, ast.Attribute) and isinstance(
+                        node.value, ast.Name) and node.value.id == selfname:
+                    for lock in guarded.get(node.attr, ()):
+                        req.add(f"{fn.cls.qual}.{lock}")
+        if not req and len(fn.cls.lock_attrs) == 1:
+            only = next(iter(fn.cls.lock_attrs))
+            req.add(f"{fn.cls.qual}.{only}")
+        world.requires[qual] = req
+
+    # may-acquire fixpoint over same-thread call edges
+    may = {q: set(a) for q, a in world.direct.items()}
+    changed = True
+    rounds = 0
+    while changed and rounds < 30:
+        changed = False
+        rounds += 1
+        for qual in may:
+            for site in repo.calls.get(qual, ()):
+                if site.kind not in ("call", "partial"):
+                    continue
+                extra = may.get(site.callee)
+                if extra and not extra <= may[qual]:
+                    may[qual] |= extra
+                    changed = True
+    world.may = may
+    return world
+
+
+def _role_sets(repo: Repo) -> dict[str, set[str]]:
+    """Which thread roles can execute each function: seeded with the role
+    of every Thread/executor spawn target, plus "request" for call-graph
+    roots (entry points invoked by the HTTP server / CLI), then propagated
+    forward along same-thread call edges."""
+    from collections import deque
+
+    roles: dict[str, set[str]] = {q: set() for q in repo.functions}
+    work: deque[str] = deque()
+    incoming: set[str] = set()
+    for sites in repo.calls.values():
+        for s in sites:
+            incoming.add(s.callee)
+    for sites in repo.calls.values():
+        for s in sites:
+            if s.kind in ("thread", "executor") and s.callee in roles:
+                r = repo.thread_roles.get(s.callee) or "worker"
+                if r not in roles[s.callee]:
+                    roles[s.callee].add(r)
+                    work.append(s.callee)
+    for q in repo.functions:
+        if q not in incoming:
+            roles[q].add("request")
+            work.append(q)
+    while work:
+        q = work.popleft()
+        for s in repo.calls.get(q, ()):
+            if s.kind not in ("call", "partial"):
+                continue
+            if s.callee in roles and not roles[q] <= roles[s.callee]:
+                roles[s.callee] |= roles[q]
+                work.append(s.callee)
+    return roles
+
+
+def _check_global_writes(repo: Repo, world: _LockWorld,
+                         roles: dict[str, set[str]]) -> list[Finding]:
+    """A `global x; x += 1` (or `= ...`) with no lock held, in a function
+    reachable from more than one thread role, is a lost-update race."""
+    findings: list[Finding] = []
+    for qual, fn in repo.functions.items():
+        if fn.name in ("__init__", "__new__", "__del__", "__post_init__"):
+            continue
+        gnames = {n for node in repo.walk_list(fn.node)
+                  if isinstance(node, ast.Global) for n in node.names}
+        if not gnames:
+            continue
+        rs = roles.get(qual, set())
+        if len(rs) < 2:
+            continue
+        lt = repo._local_instance_types(fn)
+
+        def visit(st: ast.stmt, held: frozenset) -> None:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # nested defs have their own global scope rules
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acq = []
+                for item in st.items:
+                    lid = world.lock_id(fn, item.context_expr, lt)
+                    if lid is not None:
+                        acq.append(lid)
+                for sub in st.body:
+                    visit(sub, held | frozenset(acq))
+                return
+            if not held and isinstance(st, (ast.Assign, ast.AugAssign,
+                                            ast.AnnAssign)):
+                targets = (st.targets if isinstance(st, ast.Assign)
+                           else [st.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in gnames:
+                        kind = ("read-modify-write of"
+                                if isinstance(st, ast.AugAssign)
+                                else "write to")
+                        findings.append(Finding(
+                            "unlocked-global-write", fn.path, st.lineno,
+                            st.col_offset,
+                            f"{fn.name}() performs an unlocked {kind} "
+                            f"module global '{t.id}' and is reachable from "
+                            f"{'/'.join(sorted(rs))} threads — lost-update "
+                            "race"))
+            for _field, value in ast.iter_fields(st):
+                if isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.stmt):
+                            visit(v, held)
+                        elif isinstance(v, ast.excepthandler):
+                            for sub in v.body:
+                                visit(sub, held)
+
+        for st in fn.node.body:
+            visit(st, frozenset())
+    return findings
+
+
+def _check_locks(repo: Repo) -> list[Finding]:
+    world = _build_lock_world(repo)
+    findings: list[Finding] = []
+    inter_edges: list[tuple[str, str, str, int]] = []
+    findings.extend(_check_global_writes(repo, world, _role_sets(repo)))
+
+    for qual, fn in repo.functions.items():
+        if fn.name in ("__init__", "__new__", "__del__", "__post_init__"):
+            continue
+        held0: frozenset = frozenset()
+        if fn.name.endswith("_locked"):
+            held0 = frozenset(world.requires.get(qual, ()))
+        local_types = repo._local_instance_types(fn)
+        role = repo.thread_roles.get(qual)
+        tail = f" [on the {role} thread]" if role else ""
+
+        def on_edge(outer, inner, node):
+            pass  # syntactic edges already collected in _build_lock_world
+
+        def on_call(call: ast.Call, held: frozenset,
+                    _fn=fn, _tail=tail, _lt=local_types):
+            resolved = repo.resolve_call(_fn, call, _lt)
+            recv_is_self = False
+            f = call.func
+            params = _fn.params()
+            selfname = params[0] if (_fn.cls and params) else None
+            if isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name) and f.value.id == selfname:
+                recv_is_self = True
+            for callee_qual, kind in resolved:
+                if kind != "call":
+                    continue
+                callee = repo.functions.get(callee_qual)
+                if callee is None:
+                    continue
+                # (1) *_locked helper called without its lock
+                req = world.requires.get(callee_qual, set())
+                if req and not req <= held:
+                    missing = sorted(req - held)
+                    findings.append(Finding(
+                        "locked-helper-unheld", _fn.path, call.lineno,
+                        call.col_offset,
+                        f"{callee.name}() assumes "
+                        f"{'/'.join(_short(m) for m in missing)} is held, "
+                        f"but {_fn.name}() calls it without the lock"
+                        f"{_tail}"))
+                # (2) re-acquiring a held non-reentrant lock
+                if held:
+                    same_instance = recv_is_self or callee.cls is None
+                    if same_instance:
+                        for lid in sorted(world.may.get(callee_qual, ())
+                                          & held):
+                            if world.kinds.get(lid) != "Lock":
+                                continue  # RLock/Condition re-enter fine
+                            if lid in world.requires.get(callee_qual, set()):
+                                continue  # helper asserts, not acquires
+                            findings.append(Finding(
+                                "lock-held-reacquire", _fn.path, call.lineno,
+                                call.col_offset,
+                                f"{_fn.name}() holds {_short(lid)} and calls "
+                                f"{callee.name}(), which (re)acquires it — "
+                                f"non-reentrant Lock self-deadlock{_tail}"))
+                # (3) interprocedural order edges
+                for h in held:
+                    for a in world.may.get(callee_qual, ()):
+                        if a != h:
+                            inter_edges.append((h, a, _fn.path, call.lineno))
+
+        _walk_held(world, fn, held0, on_call, on_edge)
+
+    # order cycles: combine syntactic + interprocedural edges, report only
+    # pairs that NEED an interprocedural edge (pure syntactic pairs are
+    # locks.check_order's lock-order-inversion)
+    syn: dict[tuple[str, str], tuple[str, int]] = {}
+    for outer, inner, path, line, _inter in (
+            (e[0], e[1], e[2], e[3], False) for e in world.edges):
+        syn.setdefault((outer, inner), (path, line))
+    inter: dict[tuple[str, str], tuple[str, int]] = {}
+    for outer, inner, path, line in inter_edges:
+        inter.setdefault((outer, inner), (path, line))
+    all_edges: dict[tuple[str, str], tuple[str, int, bool]] = {}
+    for k, (p, l) in syn.items():
+        all_edges[k] = (p, l, False)
+    for k, (p, l) in inter.items():
+        if k not in all_edges:
+            all_edges[k] = (p, l, True)
+    reported: set[frozenset] = set()
+    for (a, b), (p1, l1, inter1) in sorted(all_edges.items()):
+        back = all_edges.get((b, a))
+        if back is None:
+            continue
+        p2, l2, inter2 = back
+        if not (inter1 or inter2):
+            continue  # fully syntactic: existing rule's territory
+        key = frozenset((a, b))
+        if key in reported:
+            continue
+        reported.add(key)
+        site_p, site_l = (p1, l1) if inter1 else (p2, l2)
+        findings.append(Finding(
+            "lock-order-cycle", site_p, site_l, 0,
+            f"call graph acquires {_short(a)} then {_short(b)} here, but "
+            f"{_short(b)} then {_short(a)} at {p2 if site_p == p1 else p1}:"
+            f"{l2 if site_p == p1 else l1} (interprocedural deadlock "
+            "hazard)"))
+    return findings
+
+
+def _short(lock_id: str) -> str:
+    parts = lock_id.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else lock_id
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def build_repo_from_files(files: list[tuple[str, str]]) -> Repo:
+    return callgraph.build_repo(files)
+
+
+def check_repo(files: list[tuple[str, str]],
+               repo: Repo | None = None,
+               trees: "dict[str, ast.Module] | None" = None) -> list[Finding]:
+    """Run all dataflow families over `files` ([(path, source)]).  Returns
+    findings attributed to concrete path:line sites (suppressible).
+    `trees` forwards already-parsed modules to the call-graph builder."""
+    if repo is None:
+        repo = callgraph.build_repo(files, trees=trees)
+    findings: list[Finding] = []
+    findings.extend(_run_taint(repo, SecretLeakSpec()))
+    findings.extend(_run_taint(repo, RetraceSpec(repo)))
+    findings.extend(_check_transitive_sync(repo))
+    findings.extend(_check_locks(repo))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

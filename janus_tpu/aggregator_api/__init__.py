@@ -45,13 +45,15 @@ class ApiError(Exception):
 
 
 def _task_resp(task: AggregatorTask) -> dict:
+    # Secrets stay out of responses: the VDAF verify key and the raw
+    # aggregator auth token are write-only through this API — the caller
+    # supplied them in the PUT/POST body and can be confirmed with a hash.
     out = {
         "task_id": str(task.task_id),
         "peer_aggregator_endpoint": task.peer_aggregator_endpoint,
         "query_type": task.query_type.to_json_obj(),
         "vdaf": task.vdaf.to_json_obj(),
         "role": task.role.name.title(),
-        "vdaf_verify_key": _b64(task.vdaf_verify_key),
         "task_expiration": (task.task_expiration.seconds
                             if task.task_expiration else None),
         "report_expiry_age": (task.report_expiry_age.seconds
@@ -64,9 +66,15 @@ def _task_resp(task: AggregatorTask) -> dict:
         "taskprov": task.taskprov,
     }
     if task.aggregator_auth_token is not None:
-        out["aggregator_auth_token"] = {
+        out["aggregator_auth_token_hash"] = {
             "type": task.aggregator_auth_token.token_type,
-            "token": task.aggregator_auth_token.token,
+            "hash": _b64(AuthenticationTokenHash.of(
+                task.aggregator_auth_token).digest),
+        }
+    elif task.aggregator_auth_token_hash is not None:
+        out["aggregator_auth_token_hash"] = {
+            "type": task.aggregator_auth_token_hash.token_type,
+            "hash": _b64(task.aggregator_auth_token_hash.digest),
         }
     return out
 

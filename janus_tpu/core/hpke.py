@@ -443,6 +443,10 @@ def open_ciphertexts_batch_raw(keypair: "HpkeKeypair",
 
 _device_failures = 0
 _DEVICE_FAILURE_LIMIT = 3
+# guards the failure counter: opens run concurrently on the hybrid
+# executor thread and request/dispatcher threads, and an unlocked += here
+# loses updates (and can step over the ==LIMIT log line entirely)
+_device_failure_lock = __import__("threading").Lock()
 
 
 def _device_disabled() -> bool:
@@ -451,16 +455,17 @@ def _device_disabled() -> bool:
 
 def _device_failed() -> None:
     global _device_failures
-    _device_failures += 1
+    with _device_failure_lock:
+        _device_failures += 1
+        n = _device_failures
     import logging
 
     log = logging.getLogger("janus_tpu.hpke")
-    if _device_failures == 1:
+    if n == 1:
         log.warning("device HPKE open failed; falling back to native/CPU",
                     exc_info=True)
-    if _device_failures == _DEVICE_FAILURE_LIMIT:
-        log.warning("device HPKE open disabled after %d failures",
-                    _device_failures)
+    if n == _DEVICE_FAILURE_LIMIT:
+        log.warning("device HPKE open disabled after %d failures", n)
 
 
 class _HybridTuner:

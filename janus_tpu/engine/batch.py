@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -54,16 +55,18 @@ from janus_tpu.vdaf.xof import XofHmacSha256Aes128, XofTurboShake128
 class _TurboXofOps:
     """Device XofTurboShake128: seed is absorbed into the sponge message."""
 
-    def __init__(self, field):
+    def __init__(self, field: Any) -> None:
         self.expand_raw = (xof_batch.expand_field64 if field is Field64
                            else xof_batch.expand_field128)
 
-    def derive_seed(self, bs, seed, dst, binder_parts, seed_size=16):
+    def derive_seed(self, bs: Any, seed: Any, dst: bytes, binder_parts: Any,
+                    seed_size: int = 16) -> Any:
         return xof_batch.derive_seed(
             bs, [xof_batch.xof_prefix(dst), seed] + list(binder_parts),
             seed_size)
 
-    def expand(self, bs, seed, dst, binder_parts, n):
+    def expand(self, bs: Any, seed: Any, dst: bytes, binder_parts: Any,
+               n: int) -> Any:
         return self.expand_raw(
             bs, [xof_batch.xof_prefix(dst), seed] + list(binder_parts), n)
 
@@ -77,18 +80,20 @@ class _HmacXofOps:
     bitsliced-CTR backend here enforces rank 1 (hmac_aes.expand_field64
     packs keystream blocks along the single report axis)."""
 
-    def __init__(self, field):
+    def __init__(self, field: Any) -> None:
         from janus_tpu.ops import hmac_aes
 
         assert field is Field64, "multiproof XOF is defined over Field64"
         self._m = hmac_aes
 
-    def derive_seed(self, bs, seed, dst, binder_parts, seed_size=32):
+    def derive_seed(self, bs: Any, seed: Any, dst: bytes, binder_parts: Any,
+                    seed_size: int = 32) -> Any:
         return self._m.derive_seed(
             bs, seed, [xof_batch.xof_prefix(dst)] + list(binder_parts),
             seed_size)
 
-    def expand(self, bs, seed, dst, binder_parts, n):
+    def expand(self, bs: Any, seed: Any, dst: bytes, binder_parts: Any,
+               n: int) -> Any:
         return self._m.expand_field64(
             bs, seed, [xof_batch.xof_prefix(dst)] + list(binder_parts), n)
 
@@ -108,11 +113,11 @@ class LaneRef:
 
     __slots__ = ("array", "lane")
 
-    def __init__(self, array, lane: int):
+    def __init__(self, array: Any, lane: int) -> None:
         self.array = array
         self.lane = lane
 
-    def __array__(self, dtype=None, copy=None):
+    def __array__(self, dtype: Any = None, copy: Any = None) -> Any:
         out = np.asarray(self.array[..., self.lane]).T
         return out.astype(dtype) if dtype is not None else out
 
@@ -130,14 +135,14 @@ class PreparedReport:
     status: str  # "finished" | "continued" | "failed"
     error: str | None = None
     outbound: ping_pong.PingPongMessage | None = None
-    out_share_raw: object | None = None  # [OUTPUT_LEN, L] uint32 (np or LaneRef)
+    out_share_raw: Any = None  # [OUTPUT_LEN, L] uint32 (np or LaneRef)
     prep_share: bytes | None = None
-    state: object | None = None  # leader: PingPongContinued
-    device_shares: object | None = None  # jax [L, OUTPUT_LEN, M], whole batch
+    state: Any = None  # leader: PingPongContinued
+    device_shares: Any = None  # jax [L, OUTPUT_LEN, M], whole batch
     lane: int | None = None
 
 
-def _bytes_rows(rows: list[bytes], width: int) -> np.ndarray:
+def _bytes_rows(rows: list[bytes], width: int) -> Any:
     return np.frombuffer(b"".join(rows), dtype=np.uint8).reshape(len(rows), width)
 
 
@@ -163,7 +168,7 @@ class BatchPrio3:
     job sizing takes care of this — SURVEY.md §7 hard part 4).
     """
 
-    def __init__(self, vdaf: Prio3, mesh=None):
+    def __init__(self, vdaf: Prio3, mesh: Any = None) -> None:
         self.vdaf = vdaf
         self.flp = vdaf.flp
         self.field = vdaf.field
@@ -182,9 +187,9 @@ class BatchPrio3:
         # multiple of the device count.
         self.mesh = mesh
         self._n_devices = mesh.size if mesh is not None else 1
-        self._helper_fns: dict[int, object] = {}
-        self._leader_fns: dict[int, object] = {}
-        self._agg_fn = None
+        self._helper_fns: dict[Any, Any] = {}
+        self._leader_fns: dict[int, Any] = {}
+        self._agg_fn: Any = None
         self.fallback_count = 0  # reports recomputed on host (observability)
         # Cumulative wall-time split of helper_init_batch, for the bench
         # harness's host/device fraction report (VERDICT r2 #7).  "device"
@@ -196,8 +201,8 @@ class BatchPrio3:
         import threading
 
         self._timings_lock = threading.Lock()
-        self.timings = {"decode": 0.0, "device": 0.0, "encode": 0.0,
-                        "batches": 0}
+        self.timings: dict[str, float] = {
+            "decode": 0.0, "device": 0.0, "encode": 0.0, "batches": 0}
 
     def bind(self, agg_param: bytes) -> "BatchPrio3":
         """Prio3 takes no aggregation parameter; binding is a no-op."""
@@ -281,7 +286,7 @@ class BatchPrio3:
         return None
 
     def _concat_fn(self, sizes: tuple[int, ...],
-                   axes: tuple[int, ...] = (0, -1)):
+                   axes: tuple[int, ...] = (0, -1)) -> Any:
         """Jitted on-device concat of per-chunk outputs: the host then
         pays ONE result fetch instead of one per chunk (each fetch costs
         a full link round trip).  `axes` gives each output's batch axis —
@@ -292,7 +297,7 @@ class BatchPrio3:
         if fn is None:
             k = len(sizes)
 
-            def concat(*arrs):
+            def concat(*arrs: Any) -> tuple[Any, ...]:
                 return tuple(
                     jnp.concatenate(arrs[j * k:(j + 1) * k], axis=ax)
                     for j, ax in enumerate(axes))
@@ -301,7 +306,8 @@ class BatchPrio3:
             self._helper_fns[key] = fn
         return fn
 
-    def _stage(self, arrays: tuple, timed: bool) -> tuple:
+    def _stage(self, arrays: tuple[Any, ...],
+               timed: bool) -> tuple[tuple[Any, ...], float]:
         """Async-stage host arrays into HBM with explicit jax.device_put.
 
         `timed` blocks on completion and feeds the link estimator — used
@@ -328,7 +334,8 @@ class BatchPrio3:
         streaming.LINK.record_up(sum(a.nbytes for a in arrays), dt)
         return staged, dt
 
-    def _fetch(self, device_arrays: tuple) -> tuple:
+    def _fetch(self, device_arrays: tuple[Any, ...]
+               ) -> tuple[tuple[Any, ...], float, float]:
         """Materialize host-bound outputs with the compute wait split from
         the transfer: block first (kernel time attributes to the device
         phase), then time the pure fetch and feed the link estimator.
@@ -349,7 +356,8 @@ class BatchPrio3:
         streaming.LINK.record_down(sum(a.nbytes for a in out), t2 - t1)
         return out, t1 - t0, t2 - t1
 
-    def _jit(self, kernel, n_sharded_args: int, out_specs):
+    def _jit(self, kernel: Any, n_sharded_args: int,
+             out_specs: tuple[tuple[int, int], ...]) -> Any:
         """jit, sharding batch arguments/outputs over the report mesh when
         one is configured.
 
@@ -374,7 +382,7 @@ class BatchPrio3:
 
     # -- host-side decoding helpers --------------------------------------
 
-    def _decode_field_vec(self, data: bytes, n: int) -> tuple[np.ndarray, bool]:
+    def _decode_field_vec(self, data: bytes, n: int) -> tuple[Any, bool]:
         """bytes -> ([n, L] uint32 raw limbs, in_range).  No exceptions."""
         want = n * self.field.ENCODED_SIZE
         if len(data) != want:
@@ -402,7 +410,8 @@ class BatchPrio3:
             raise VdafError("bad prep share length")
         return data[:ss], data[ss:]
 
-    def _decode_field_vec_batch(self, rows: np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+    def _decode_field_vec_batch(self, rows: Any,
+                                n: int) -> tuple[Any, Any]:
         """Batched field-vector decode: [K, n*ENCODED_SIZE] u8 ->
         ([K, n, L] u32 raw limbs, in_range [K]).  One vectorized pass over
         the whole batch — no per-report Python (VERDICT round-1 weak #4)."""
@@ -428,7 +437,9 @@ class BatchPrio3:
     def _dst(self, usage: int) -> bytes:
         return self.vdaf.dst(usage)
 
-    def _kernel_common(self, bs, meas_raw, proofs_raw, nonces, vk, parts_static):
+    def _kernel_common(self, bs: Any, meas_raw: Any, proofs_raw: Any,
+                       nonces: Any, vk: Any,
+                       parts_static: Any) -> tuple[Any, ...]:
         """Shared tail: joint/query randomness + FLP query.
 
         meas_raw / proofs_raw are raw limbs in the kernel layout
@@ -478,14 +489,14 @@ class BatchPrio3:
         bad_t = jnp.any(bad_t, axis=0)  # over the proof axis
         return verifier, state_seed, reject, bad_t, meas
 
-    def _helper_fn(self, N: int):
+    def _helper_fn(self, N: int) -> Any:
         if N in self._helper_fns:
             return self._helper_fns[N]
         f = self.f
         P = self.P
         vlen = self.flp.VERIFIER_LEN
 
-        def kernel(packed, leader_verifs_raw):
+        def kernel(packed: Any, leader_verifs_raw: Any) -> Any:
             # `packed` [N, ks + 4*ss + 16] u8: vk | seeds | blinds | nonces |
             # pub0 | leader_jr_parts.  One bundled row per report = ONE
             # host->device transfer for all byte inputs — per-transfer
@@ -553,14 +564,14 @@ class BatchPrio3:
         self._helper_fns[N] = fn
         return fn
 
-    def _leader_fn(self, N: int):
+    def _leader_fn(self, N: int) -> Any:
         if N in self._leader_fns:
             return self._leader_fns[N]
         f = self.f
         P = self.P
         vlen = self.flp.VERIFIER_LEN
 
-        def kernel(packed, meas_rows, proofs_rows):
+        def kernel(packed: Any, meas_rows: Any, proofs_rows: Any) -> Any:
             # `packed` [N, ks + ss + 16 + ss] u8: vk | blinds | nonces | pub1
             # — one transfer for all byte inputs (see _helper_fn).
             bs = (N,)
@@ -605,8 +616,12 @@ class BatchPrio3:
 
     # -- public batched API ----------------------------------------------
 
-    def _pack_helper_inputs(self, M, verify_key, nonces, public_shares,
-                            input_shares, inbound_messages):
+    def _pack_helper_inputs(self, M: int, verify_key: Any,
+                            nonces: list[bytes],
+                            public_shares: list[bytes],
+                            input_shares: list[bytes],
+                            inbound_messages: Any
+                            ) -> tuple[Any, Any, dict[int, str]]:
         """Host-side packing for the helper kernel: bundled byte tensor
         (vk | seeds | blinds | nonces | pub0 | leader_jr_parts — one
         transfer instead of six) + the leader verifier limbs + per-lane
@@ -670,8 +685,10 @@ class BatchPrio3:
         nonce_rows[:N] = nonces_arr(nonces)
         return packed, lverif, decode_err
 
-    def device_resident_rate(self, verify_key, nonces, public_shares,
-                             input_shares, inbound_messages,
+    def device_resident_rate(self, verify_key: Any, nonces: list[bytes],
+                             public_shares: list[bytes],
+                             input_shares: list[bytes],
+                             inbound_messages: Any,
                              iters: int = 3) -> float:
         """Kernel-sustained helper-init rate with inputs ALREADY in HBM —
         the bench publishes this beside the end-to-end number so the
@@ -770,13 +787,13 @@ class BatchPrio3:
             for c in chunk_sizes[:-1]:
                 offs.append(offs[-1] + c)
 
-            def slices(k: int) -> tuple:
+            def slices(k: int) -> tuple[Any, ...]:
                 o, c = offs[k], chunk_sizes[k]
                 return (packed[o:o + c], lverif[o:o + c])
 
             staged, t_up = self._stage(slices(0), timed=self.streaming)
             transfer_s += t_up
-            parts = []
+            parts: list[Any] = []
             for k, c in enumerate(chunk_sizes):
                 parts.append(self._helper_fn(c)(*staged))
                 if k + 1 < len(chunk_sizes):
@@ -960,14 +977,14 @@ class BatchPrio3:
             for c in chunk_sizes[:-1]:
                 offs.append(offs[-1] + c)
 
-            def slices(k: int) -> tuple:
+            def slices(k: int) -> tuple[Any, ...]:
                 o, c = offs[k], chunk_sizes[k]
                 return (packed[o:o + c], meas_raw[o:o + c],
                         proofs_raw[o:o + c])
 
             staged, t_up = self._stage(slices(0), timed=self.streaming)
             transfer_s += t_up
-            parts = []
+            parts: list[Any] = []
             for k, c in enumerate(chunk_sizes):
                 parts.append(self._leader_fn(c)(*staged))
                 if k + 1 < len(chunk_sizes):
@@ -1045,7 +1062,9 @@ class BatchPrio3:
 
     # -- host fallbacks ----------------------------------------------------
 
-    def _host_helper(self, verify_key, nonce, public_share, input_share, inbound):
+    def _host_helper(self, verify_key: bytes, nonce: bytes,
+                     public_share: bytes, input_share: bytes,
+                     inbound: Any) -> PreparedReport:
         try:
             pub = self.vdaf.decode_public_share(public_share)
             ishare = self.vdaf.decode_input_share(1, input_share)
@@ -1060,7 +1079,9 @@ class BatchPrio3:
         except (VdafError, ValueError, AssertionError, NotImplementedError) as e:
             return PreparedReport("failed", error=str(e))
 
-    def _host_leader(self, verify_key, nonce, public_share, input_share):
+    def _host_leader(self, verify_key: bytes, nonce: bytes,
+                     public_share: bytes,
+                     input_share: bytes) -> PreparedReport:
         try:
             pub = self.vdaf.decode_public_share(public_share)
             ishare = self.vdaf.decode_input_share(0, input_share)
@@ -1082,7 +1103,7 @@ class BatchPrio3:
         inbound_messages: list[ping_pong.PingPongMessage],
     ) -> list[PreparedReport]:
         """Batched ping_pong.leader_continued: cheap host-side seed compare."""
-        out = []
+        out: list[PreparedReport] = []
         for rep, msg in zip(reports, inbound_messages):
             if rep.status != "continued":
                 out.append(rep)
@@ -1113,7 +1134,7 @@ class BatchPrio3:
         ]
         return self.aggregate_raw_rows(rows)
 
-    def aggregate_raw_rows(self, rows: list) -> list[int]:
+    def aggregate_raw_rows(self, rows: list[Any]) -> list[int]:
         """Device tree-sum of raw output-share rows -> aggregate share ints.
 
         Rows may be host arrays OR LaneRef handles into HBM-resident init
@@ -1127,8 +1148,8 @@ class BatchPrio3:
         if not rows:
             return self.vdaf.aggregate_init()
         jax_array = getattr(jax, "Array", ())
-        groups: dict[int, tuple] = {}
-        host_rows: list = []
+        groups: dict[int, tuple[Any, list[int]]] = {}
+        host_rows: list[Any] = []
         for r in rows:
             arr = getattr(r, "array", None)
             lane = getattr(r, "lane", None)
@@ -1137,7 +1158,7 @@ class BatchPrio3:
                 groups.setdefault(id(arr), (arr, []))[1].append(lane)
             else:
                 host_rows.append(r)
-        handles = []
+        handles: list[Any] = []
         for arr, lanes in groups.values():
             if len(set(lanes)) != len(lanes):
                 # a repeated lane can't be expressed as a 0/1 mask;
@@ -1157,7 +1178,7 @@ class BatchPrio3:
         mod = self.field.MODULUS
         return [sum(vals) % mod for vals in zip(*parts)]
 
-    def _aggregate_host_rows(self, rows: list) -> list[int]:
+    def _aggregate_host_rows(self, rows: list[Any]) -> list[int]:
         """Upload-and-reduce for host-resident rows (the pre-streaming
         path, still used for host-oracle fallback lanes)."""
         rows = [np.asarray(r) for r in rows]  # each [OUTPUT_LEN, L]
@@ -1169,7 +1190,7 @@ class BatchPrio3:
         mask[:K] = True
         return self.aggregate_masked(arr, mask)
 
-    def aggregate_masked_launch(self, shares, mask):
+    def aggregate_masked_launch(self, shares: Any, mask: Any) -> Any:
         """Dispatch the masked modular sum WITHOUT materializing: returns
         the async on-device [L, OUT] value.  Callers that know the mask
         early (the columnar init path launches before opening its datastore
@@ -1181,11 +1202,11 @@ class BatchPrio3:
             self._agg_fn = aggregate_fn(self.f, self.mesh)
         return self._agg_fn(shares, np.asarray(mask))
 
-    def aggregate_resolve(self, handle) -> list[int]:
+    def aggregate_resolve(self, handle: Any) -> list[int]:
         res = np.asarray(handle)  # [L, OUT]
         return self._raw_to_ints(res.T)
 
-    def aggregate_masked(self, shares, mask) -> list[int]:
+    def aggregate_masked(self, shares: Any, mask: Any) -> list[int]:
         """Masked modular sum over the report axis, entirely on device:
         `shares` may be the engine's resident [L, OUTPUT_LEN, M] batch array,
         so only the [L, OUTPUT_LEN] result crosses to the host."""
@@ -1193,13 +1214,13 @@ class BatchPrio3:
 
     # -- limb conversion helpers ------------------------------------------
 
-    def _raw_to_ints(self, raw: np.ndarray) -> list[int]:
-        out = []
+    def _raw_to_ints(self, raw: Any) -> list[int]:
+        out: list[int] = []
         for row in np.asarray(raw, dtype=np.uint32):
             out.append(sum(int(row[k]) << (32 * k) for k in range(self.L)))
         return out
 
-    def _ints_to_raw(self, vals: list[int]) -> np.ndarray:
+    def _ints_to_raw(self, vals: list[int]) -> Any:
         arr = np.zeros((len(vals), self.L), dtype=np.uint32)
         for i, v in enumerate(vals):
             for k in range(self.L):
@@ -1207,5 +1228,5 @@ class BatchPrio3:
         return arr
 
 
-def nonces_arr(nonces: list[bytes]) -> np.ndarray:
+def nonces_arr(nonces: list[bytes]) -> Any:
     return _bytes_rows(nonces, 16)

@@ -24,6 +24,7 @@ at ~2^-32 per sampled element).
 from __future__ import annotations
 
 import time
+from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
 
@@ -43,28 +44,28 @@ class _PreEncodedMessage:
 
     __slots__ = ("_data", "_msg")
 
-    def __init__(self, data: bytes):
+    def __init__(self, data: bytes) -> None:
         self._data = data
-        self._msg = None
+        self._msg: ping_pong.PingPongMessage | None = None
 
     def encode(self) -> bytes:
         return self._data
 
-    def _decoded(self):
+    def _decoded(self) -> ping_pong.PingPongMessage:
         if self._msg is None:
             self._msg = ping_pong.PingPongMessage.decode(self._data)
         return self._msg
 
     @property
-    def type(self):
+    def type(self) -> int:
         return self._data[0]
 
     @property
-    def prep_msg(self):
+    def prep_msg(self) -> bytes | None:
         return self._decoded().prep_msg
 
     @property
-    def prep_share(self):
+    def prep_share(self) -> bytes | None:
         return self._decoded().prep_share
 
 
@@ -77,13 +78,13 @@ class _LazyContinued:
     finished = False
     current_round = 1
 
-    def __init__(self, vdaf, state_bytes: bytes):
+    def __init__(self, vdaf: Any, state_bytes: bytes) -> None:
         self._vdaf = vdaf
         self._bytes = state_bytes
-        self._state = None
+        self._state: Any = None
 
     @property
-    def prep_state(self):
+    def prep_state(self) -> Any:
         if self._state is None:
             self._state, _rnd = self._vdaf.decode_prep_state(self._bytes)
         return self._state
@@ -95,14 +96,15 @@ class _CachedPrepVdaf:
 
     __slots__ = ("_vdaf", "_cached")
 
-    def __init__(self, vdaf, cached):
+    def __init__(self, vdaf: Any, cached: Any) -> None:
         self._vdaf = vdaf
         self._cached = cached
 
-    def prep_init(self, verify_key, agg_id, nonce, public_share, input_share):
+    def prep_init(self, verify_key: Any, agg_id: Any, nonce: Any,
+                  public_share: Any, input_share: Any) -> Any:
         return self._cached
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> Any:
         return getattr(self._vdaf, name)
 
 
@@ -111,13 +113,13 @@ class BatchPoplar1(HostPrepEngine):
     device batch per call (inner levels)."""
 
     def __init__(self, vdaf: Poplar1, device_min_batch: int = 32,
-                 _fns: dict | None = None):
+                 _fns: dict[Any, Any] | None = None) -> None:
         super().__init__(vdaf)
         # jitted-kernel cache, SHARED with every bound copy (the aggregator
         # binds a fresh engine per job; a per-instance cache would recompile
         # per request).  Keyed on everything the kernel closure bakes in:
         # (bucketed N, P, level, party) — the verify key is a runtime input.
-        self._fns = {} if _fns is None else _fns
+        self._fns: dict[Any, Any] = {} if _fns is None else _fns
         # below this many reports the jit dispatch (and on cold caches the
         # compile) costs more than the host loop; small service batches take
         # the oracle path
@@ -140,7 +142,8 @@ class BatchPoplar1(HostPrepEngine):
         # leaf (ops/field255.py + eval_leaf_level) since round 3
         return len(prefixes) > 0
 
-    def _sketch_body(self, N: int, P: int, level: int, party: bool):
+    def _sketch_body(self, N: int, P: int, level: int,
+                     party: bool) -> Callable[..., Any]:
         """The shared IDPF-walk + sketch trace: ONE definition consumed by
         both the oracle-framing kernel (_precompute) and the fused fast
         kernel (_helper_fast_fn), so the two jitted paths cannot drift.
@@ -161,8 +164,9 @@ class BatchPoplar1(HostPrepEngine):
                   else xof_batch.expand_field64)
         binder_static = level.to_bytes(2, "big") + P.to_bytes(4, "big")
 
-        def body(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
-                 corr_seeds, nonce_rows, pb, offs=None):
+        def body(vk_rows: Any, fixed: Any, seeds: Any, cw_seeds: Any,
+                 cw_ctrls: Any, payload: Any, corr_seeds: Any,
+                 nonce_rows: Any, pb: Any, offs: Any = None) -> Any:
             parties = jnp.full((N,), party, dtype=bool)
             if leaf:
                 ys, rej0 = eval_leaf_level(
@@ -189,7 +193,9 @@ class BatchPoplar1(HostPrepEngine):
 
         return body
 
-    def _precompute(self, verify_key: bytes, agg_id: int, nonces, decoded):
+    def _precompute(self, verify_key: bytes, agg_id: int,
+                    nonces: Sequence[bytes],
+                    decoded: Sequence[Any]) -> list[Any]:
         """Device batch over all decodable reports.
 
         decoded: list of (key, corr_seed, offsets) | None per report.
@@ -264,8 +270,9 @@ class BatchPoplar1(HostPrepEngine):
 
             body = self._sketch_body(N, P, level, party)
 
-            def kernel(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
-                       corr_seeds, offs, nonce_rows, pb):
+            def kernel(vk_rows: Any, fixed: Any, seeds: Any, cw_seeds: Any,
+                       cw_ctrls: Any, payload: Any, corr_seeds: Any,
+                       offs: Any, nonce_rows: Any, pb: Any) -> Any:
                 return body(vk_rows, fixed, seeds, cw_seeds, cw_ctrls,
                             payload, corr_seeds, nonce_rows, pb, offs)
 
@@ -288,7 +295,7 @@ class BatchPoplar1(HostPrepEngine):
             resilient.raise_if_backend_error(e)
             raise
 
-        def to_ints(arr_d) -> np.ndarray:
+        def to_ints(arr_d: Any) -> Any:
             """Vectorized limb fold: [L, ...] u32 -> object array of ints
             (one whole-array pass, not per-scalar indexing in the loop)."""
             arr = np.asarray(arr_d)
@@ -304,7 +311,7 @@ class BatchPoplar1(HostPrepEngine):
         abc_i = to_ints(abc_d)  # [3, N]
         r1_i = to_ints(r1_d)    # [3, N]
 
-        out: list = [None] * len(decoded)
+        out: list[Any] = [None] * len(decoded)
         for k, i in enumerate(idx):
             if rej[k]:
                 # racy += under concurrent job workers without the lock
@@ -320,7 +327,7 @@ class BatchPoplar1(HostPrepEngine):
 
     # -- columnar helper fast path ----------------------------------------
 
-    def _helper_share_layout(self, level: int):
+    def _helper_share_layout(self, level: int) -> tuple[int, int, int]:
         """Byte offsets inside the HELPER input share (corr_seed ||
         IdpfKey; agg_id=1 carries no offsets — poplar1.py
         encode_input_share).  Everything is fixed-length given `bits`."""
@@ -331,7 +338,7 @@ class BatchPoplar1(HostPrepEngine):
         total = pcs + 8 * (b - 1) + 32
         return cw_start, pcw_off, total
 
-    def _helper_fast_fn(self, N: int, P: int, level: int):
+    def _helper_fast_fn(self, N: int, P: int, level: int) -> Any:
         """One device program for the WHOLE helper round-0: IDPF walk +
         sketch + combine with the leader's round-1 share + the round-2
         sigma share (prep_shares_to_prep + prep_next fused), returning a
@@ -353,8 +360,9 @@ class BatchPoplar1(HostPrepEngine):
         fops = f255 if leaf else f64
         body = self._sketch_body(N, P, level, party=True)  # helper
 
-        def kernel(vk_rows, fixed, seeds, cw_seeds, cw_ctrls, payload,
-                   corr_seeds, nonce_rows, pb, leader_r1):
+        def kernel(vk_rows: Any, fixed: Any, seeds: Any, cw_seeds: Any,
+                   cw_ctrls: Any, payload: Any, corr_seeds: Any,
+                   nonce_rows: Any, pb: Any, leader_r1: Any) -> Any:
             ys, abc, r1, rej = body(vk_rows, fixed, seeds, cw_seeds,
                                     cw_ctrls, payload, corr_seeds,
                                     nonce_rows, pb)
@@ -382,8 +390,10 @@ class BatchPoplar1(HostPrepEngine):
 
     # -- engine surface ----------------------------------------------------
 
-    def helper_init_batch(self, verify_key, nonces, public_shares,
-                          input_shares, inbound_messages):
+    def helper_init_batch(self, verify_key: bytes, nonces: Sequence[bytes],
+                          public_shares: Sequence[bytes],
+                          input_shares: Sequence[bytes],
+                          inbound_messages: Sequence[Any]) -> list[Any]:
         if not self._device_eligible() or len(nonces) < self.device_min_batch:
             return self._helper_init_oracle(
                 verify_key, nonces, public_shares, input_shares,
@@ -414,7 +424,7 @@ class BatchPoplar1(HostPrepEngine):
                 slow.append(i)
             else:
                 fast.append(i)
-        out: list = [None] * n
+        out: list[Any] = [None] * n
         if fast:
             arr = np.frombuffer(
                 b"".join(input_shares[i] for i in fast),
@@ -553,8 +563,12 @@ class BatchPoplar1(HostPrepEngine):
                 out[i] = rep
         return out
 
-    def _helper_init_oracle(self, verify_key, nonces, public_shares,
-                            input_shares, inbound_messages, lanes):
+    def _helper_init_oracle(self, verify_key: bytes,
+                            nonces: Sequence[bytes],
+                            public_shares: Sequence[bytes],
+                            input_shares: Sequence[bytes],
+                            inbound_messages: Sequence[Any],
+                            lanes: Iterable[int]) -> list[Any]:
         """The pre-columnar path (device _precompute + per-report oracle
         framing) over `lanes`; also the semantic reference for the fast
         path, kept in lockstep by tests/test_idpf_batch.py."""
@@ -569,7 +583,7 @@ class BatchPoplar1(HostPrepEngine):
                 [public_shares[i] for i in lanes],
                 [input_shares[i] for i in lanes],
                 [inbound_messages[i] for i in lanes])
-        decoded = []
+        decoded: list[Any] = []
         errors: dict[int, str] = {}
         for i in lanes:
             try:
@@ -581,7 +595,7 @@ class BatchPoplar1(HostPrepEngine):
                 decoded.append(None)
         cached = self._precompute(
             verify_key, 1, [nonces[i] for i in lanes], decoded)
-        out = []
+        out: list[Any] = []
         for j, i in enumerate(lanes):
             inbound = inbound_messages[i]
             if i in errors:
@@ -610,14 +624,15 @@ class BatchPoplar1(HostPrepEngine):
                 out.append(PreparedReport("failed", error=str(e)))
         return out
 
-    def leader_init_batch(self, verify_key, nonces, public_shares,
-                          input_shares):
+    def leader_init_batch(self, verify_key: bytes, nonces: Sequence[bytes],
+                          public_shares: Sequence[bytes],
+                          input_shares: Sequence[bytes]) -> list[Any]:
         if not self._device_eligible() or len(nonces) < self.device_min_batch:
             return super().leader_init_batch(
                 verify_key, nonces, public_shares, input_shares)
         from janus_tpu.engine.batch import PreparedReport
 
-        decoded = []
+        decoded: list[Any] = []
         errors: dict[int, str] = {}
         for i, (pub, in_bytes) in enumerate(zip(public_shares, input_shares)):
             try:
@@ -627,7 +642,7 @@ class BatchPoplar1(HostPrepEngine):
                 errors[i] = str(e)
                 decoded.append(None)
         cached = self._precompute(verify_key, 0, nonces, decoded)
-        out = []
+        out: list[Any] = []
         for i in range(len(nonces)):
             if i in errors:
                 out.append(PreparedReport("failed", error=errors[i]))

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Any, Sequence
 
 from janus_tpu.engine import streaming
 from janus_tpu.engine.batch import BatchPrio3, PreparedReport
@@ -32,7 +33,8 @@ from janus_tpu.engine.batch import BatchPrio3, PreparedReport
 class _Pending:
     __slots__ = ("kind", "verify_key", "args", "n", "event", "result", "error")
 
-    def __init__(self, kind: str, verify_key: bytes, args: tuple, n: int):
+    def __init__(self, kind: str, verify_key: bytes,
+                 args: tuple[Any, ...], n: int) -> None:
         self.kind = kind
         self.verify_key = verify_key
         self.args = args  # tuple of per-report lists
@@ -51,7 +53,7 @@ class CoalescingEngine:
 
     def __init__(self, inner: BatchPrio3, max_batch: int = 16384,
                  max_delay_ms: float = 4.0, launch_depth: int = 4,
-                 adaptive: bool | None = None):
+                 adaptive: bool | None = None) -> None:
         self.inner = inner
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1000.0
@@ -86,56 +88,61 @@ class CoalescingEngine:
     # -- facade ------------------------------------------------------------
 
     @property
-    def vdaf(self):
+    def vdaf(self) -> Any:
         return self.inner.vdaf
 
     @property
-    def device_ok(self):
+    def device_ok(self) -> bool:
         return self.inner.device_ok
 
     @property
-    def fallback_count(self):
+    def fallback_count(self) -> int:
         return self.inner.fallback_count
 
     @property
-    def timings(self):
+    def timings(self) -> Any:
         return self.inner.timings
 
     @timings.setter
-    def timings(self, value):
+    def timings(self, value: Any) -> None:
         self.inner.timings = value
 
-    def bind(self, agg_param: bytes):
+    def bind(self, agg_param: bytes) -> "CoalescingEngine":
         self.inner.bind(agg_param)  # raises on a bad param
         return self
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> Any:
         # anything not coalescing-specific (host fallbacks, field/flp
         # introspection) passes through to the inner engine
         return getattr(self.inner, name)
 
-    def aggregate(self, reports):
+    def aggregate(self, reports: Any) -> Any:
         return self.inner.aggregate(reports)
 
-    def aggregate_raw_rows(self, rows):
+    def aggregate_raw_rows(self, rows: Any) -> Any:
         return self.inner.aggregate_raw_rows(rows)
 
-    def aggregate_masked(self, shares, mask):
+    def aggregate_masked(self, shares: Any, mask: Any) -> Any:
         return self.inner.aggregate_masked(shares, mask)
 
-    def leader_finish(self, reports, inbound_messages):
+    def leader_finish(self, reports: Any, inbound_messages: Any) -> Any:
         return self.inner.leader_finish(reports, inbound_messages)
 
     # -- coalesced entry points -------------------------------------------
 
-    def helper_init_batch(self, verify_key, nonces, public_shares,
-                          input_shares, inbound_messages):
+    def helper_init_batch(self, verify_key: bytes, nonces: Sequence[Any],
+                          public_shares: Sequence[Any],
+                          input_shares: Sequence[Any],
+                          inbound_messages: Sequence[Any]
+                          ) -> list[PreparedReport]:
         return self._submit("helper", verify_key,
                             (nonces, public_shares, input_shares,
                              inbound_messages))
 
-    def leader_init_batch(self, verify_key, nonces, public_shares,
-                          input_shares):
+    def leader_init_batch(self, verify_key: bytes, nonces: Sequence[Any],
+                          public_shares: Sequence[Any],
+                          input_shares: Sequence[Any]
+                          ) -> list[PreparedReport]:
         return self._submit("leader", verify_key,
                             (nonces, public_shares, input_shares))
 
@@ -176,7 +183,7 @@ class CoalescingEngine:
         lane_bytes = getattr(self.inner, "lane_upload_bytes", None)
         if lane_bytes is None:
             return
-        tuned = {}
+        tuned: dict[str, tuple[int, float]] = {}
         for kind in ("helper", "leader"):
             mb, delay_ms = streaming.recommend_coalesce_params(
                 streaming.LINK, lane_bytes(kind),
@@ -186,7 +193,8 @@ class CoalescingEngine:
         with self._lock:
             self._tuned = tuned
 
-    def _submit(self, kind: str, verify_key, args) -> list[PreparedReport]:
+    def _submit(self, kind: str, verify_key: bytes,
+                args: tuple[Any, ...]) -> list[PreparedReport]:
         n = len(args[0])
         if n == 0:
             return []
@@ -205,6 +213,7 @@ class CoalescingEngine:
         p.event.wait()
         if p.error is not None:
             raise p.error
+        assert p.result is not None
         return p.result
 
     def _dispatch_loop(self) -> None:
@@ -251,7 +260,7 @@ class CoalescingEngine:
     def _run_group(self, kind: str, group: list[_Pending]) -> None:
         try:
             n_args = len(group[0].args)
-            merged = [[] for _ in range(n_args)]
+            merged: list[list[Any]] = [[] for _ in range(n_args)]
             vks: list[bytes] = []
             for p in group:
                 for j in range(n_args):

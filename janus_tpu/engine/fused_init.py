@@ -31,6 +31,7 @@ from __future__ import annotations
 import struct
 import threading
 import time
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -47,16 +48,16 @@ _U32 = jnp.uint32
 class FusedLaunch:
     """An in-flight fused program: dispatched, not yet materialized."""
 
-    def __init__(self, out_d, share_d, n: int, ss: int, has_jr: bool,
-                 profile: dict | None = None):
+    def __init__(self, out_d: Any, share_d: Any, n: int, ss: int,
+                 has_jr: bool, profile: dict[str, Any] | None = None) -> None:
         self._out_d = out_d
         self.device_shares = share_d  # [L, OUT, M], resident
         self.n = n
         self._ss = ss if has_jr else 0
-        self._res = None
+        self._res: dict[str, Any] | None = None
         self._profile = profile
 
-    def fetch(self) -> dict:
+    def fetch(self) -> dict[str, Any]:
         """Block on the single device->host transfer; split the columns.
 
         The kernel wait is split from the fetch so the profiler attributes
@@ -108,14 +109,14 @@ class FusedLaunch:
 class FusedHelperInit:
     """Builds/caches the fused programs for one BatchPrio3 engine."""
 
-    def __init__(self, engine):
+    def __init__(self, engine: Any) -> None:
         self.engine = engine
-        self._fns: dict[tuple, object] = {}
+        self._fns: dict[tuple[int, int, int, int], Any] = {}
         self._lock = threading.Lock()
 
     # -- static shape plumbing -------------------------------------------
 
-    def _sizes(self):
+    def _sizes(self) -> tuple[int, int, int, int, int]:
         e = self.engine
         ss = e.vdaf.SEED_SIZE
         ishare = ss + (ss if e.has_jr else 0)
@@ -124,7 +125,7 @@ class FusedHelperInit:
         ps = ps_jr + e.P * e.flp.VERIFIER_LEN * e.field.ENCODED_SIZE
         return ss, ishare, pub, ps_jr, ps
 
-    def supported(self, keypair) -> bool:
+    def supported(self, keypair: Any) -> bool:
         e = self.engine
         cfg = keypair.config
         return bool(
@@ -138,7 +139,7 @@ class FusedHelperInit:
 
     # -- kernel -----------------------------------------------------------
 
-    def _fn(self, M: int, cl: int, pl: int, ml: int):
+    def _fn(self, M: int, cl: int, pl: int, ml: int) -> Any:
         key = (M, cl, pl, ml)
         with self._lock:
             fn = self._fns.get(key)
@@ -155,7 +156,7 @@ class FusedHelperInit:
         TYPE_INIT = ping_pong.PingPongMessage.TYPE_INITIALIZE
         msg_len_be = np.frombuffer(struct.pack(">I", ml - 5), np.uint8)
 
-        def kernel(const_row, lanes):
+        def kernel(const_row: Any, lanes: Any) -> tuple[Any, Any]:
             # const_row [1, 161+ks] u8: sk(32)|pk(32)|ksc(65)|vk(ks)|tid(32)
             # lanes [M, 24+32+cl+pl+ml] u8:
             #   rid+time(24) | enc(32) | ct(cl) | pub(pl) | msg(ml)
@@ -266,8 +267,9 @@ class FusedHelperInit:
 
     # -- host driver ------------------------------------------------------
 
-    def run(self, keypair, info: bytes, verify_key: bytes, tid_b: bytes,
-            body: bytes, table: np.ndarray) -> FusedLaunch | None:
+    def run(self, keypair: Any, info: bytes, verify_key: bytes,
+            tid_b: bytes, body: bytes,
+            table: Any) -> FusedLaunch | None:
         """Validate uniformity, pack via vectorized gathers, dispatch.
 
         Returns None when the request doesn't fit the fused contract —
@@ -308,7 +310,7 @@ class FusedHelperInit:
 
         lanes = np.zeros((M, 24 + 32 + cl + pl + ml), np.uint8)
 
-        def gather(col: int, ln: int, at: int):
+        def gather(col: int, ln: int, at: int) -> None:
             if ln:
                 idx = table[:, col, None] + np.arange(ln)
                 lanes[:n, at:at + ln] = body_arr[idx]
@@ -331,8 +333,9 @@ class FusedHelperInit:
             resilient.raise_if_backend_error(err)
             raise
 
-    def _dispatch(self, e, fn, const_row, lanes, n, ss, M, cold,
-                  t_begin, t_pack) -> FusedLaunch:
+    def _dispatch(self, e: Any, fn: Any, const_row: Any, lanes: Any,
+                  n: int, ss: int, M: int, cold: bool,
+                  t_begin: float, t_pack: float) -> FusedLaunch:
         t_up = 0.0
         if getattr(e, "streaming", False):
             # explicit timed staging (streaming data plane): the upload
@@ -363,7 +366,7 @@ class FusedHelperInit:
 _attach_lock = threading.Lock()
 
 
-def fused_for(engine) -> FusedHelperInit | None:
+def fused_for(engine: Any) -> FusedHelperInit | None:
     """Lazily attach a FusedHelperInit to a BatchPrio3 engine (or the
     innermost engine of wrapper stacks — resilient/coalescing); None when
     the engine can't fuse.  Locked check-then-set: concurrent first

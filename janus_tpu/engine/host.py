@@ -8,6 +8,8 @@ surface regardless of backing implementation.
 
 from __future__ import annotations
 
+from typing import Any, Iterable, Sequence
+
 import numpy as np
 
 from janus_tpu.engine.batch import PreparedReport
@@ -16,7 +18,7 @@ from janus_tpu.vdaf.prio3 import VdafError
 
 
 class HostPrepEngine:
-    def __init__(self, vdaf):
+    def __init__(self, vdaf: Any) -> None:
         self.vdaf = vdaf
         self.fallback_count = 0
 
@@ -29,19 +31,22 @@ class HostPrepEngine:
             raise VdafError("unexpected aggregation parameter")
         return self
 
-    def _out_share_arr(self, out_share) -> np.ndarray:
+    def _out_share_arr(self, out_share: Iterable[int]) -> Any:
         return np.asarray([[v & 0xFFFFFFFF, v >> 32] for v in out_share],
                           dtype=np.uint64).astype(np.uint32)
 
-    def _raw_to_ints(self, raw) -> list[int]:
+    def _raw_to_ints(self, raw: Any) -> list[int]:
         raw = np.asarray(raw)  # [OUTPUT_LEN, LIMBS] little-endian u32 limbs
         return [
             sum(int(row[k]) << (32 * k) for k in range(raw.shape[-1]))
             for row in raw
         ]
 
-    def helper_init_batch(self, verify_key, nonces, public_shares, input_shares,
-                          inbound_messages) -> list[PreparedReport]:
+    def helper_init_batch(self, verify_key: bytes, nonces: Sequence[bytes],
+                          public_shares: Sequence[bytes],
+                          input_shares: Sequence[bytes],
+                          inbound_messages: Sequence[Any]
+                          ) -> list[PreparedReport]:
         out = []
         for nonce, pub_bytes, in_bytes, inbound in zip(
             nonces, public_shares, input_shares, inbound_messages
@@ -69,8 +74,10 @@ class HostPrepEngine:
                 out.append(PreparedReport("failed", error=str(e)))
         return out
 
-    def leader_init_batch(self, verify_key, nonces, public_shares,
-                          input_shares) -> list[PreparedReport]:
+    def leader_init_batch(self, verify_key: bytes, nonces: Sequence[bytes],
+                          public_shares: Sequence[bytes],
+                          input_shares: Sequence[bytes]
+                          ) -> list[PreparedReport]:
         out = []
         for nonce, pub_bytes, in_bytes in zip(nonces, public_shares, input_shares):
             try:
@@ -88,7 +95,9 @@ class HostPrepEngine:
                 out.append(PreparedReport("failed", error=str(e)))
         return out
 
-    def leader_finish(self, reports, inbound_messages) -> list[PreparedReport]:
+    def leader_finish(self, reports: Sequence[PreparedReport],
+                      inbound_messages: Sequence[Any]
+                      ) -> list[PreparedReport]:
         out = []
         for rep, msg in zip(reports, inbound_messages):
             if rep.status != "continued":
@@ -110,13 +119,13 @@ class HostPrepEngine:
                 out.append(PreparedReport("failed", error=str(e)))
         return out
 
-    def aggregate(self, reports) -> list:
+    def aggregate(self, reports: Iterable[PreparedReport]) -> list[Any]:
         return self.aggregate_raw_rows([
             rep.out_share_raw for rep in reports
             if rep.status == "finished" and rep.out_share_raw is not None
         ])
 
-    def aggregate_raw_rows(self, rows) -> list:
+    def aggregate_raw_rows(self, rows: Iterable[Any]) -> list[Any]:
         agg = self.vdaf.aggregate_init()
         for raw in rows:
             ints = raw if isinstance(raw, list) else self._raw_to_ints(raw)

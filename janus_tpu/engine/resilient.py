@@ -47,6 +47,7 @@ import os
 import threading
 import time
 import weakref
+from typing import Any, Callable
 
 import numpy as np
 
@@ -156,16 +157,16 @@ def _chaos_error() -> BackendUnavailable:
 # -- probes -----------------------------------------------------------------
 
 
-def probe_backend(timeout_s: float, op: bool = False):
+def probe_backend(timeout_s: float, op: bool = False) -> Any:
     """jax.devices() under a watchdog thread: the tunneled backend can
     HANG during init instead of raising (socket connects, handshake
     never completes).  A timeout is treated exactly like an init failure
     — BackendUnavailable.  With ``op`` a tiny eager computation also
     round-trips the device, which catches a backend that enumerates but
     cannot launch.  Returns the device list."""
-    result: dict = {}
+    result: dict[str, Any] = {}
 
-    def probe():
+    def probe() -> None:
         try:
             import jax
 
@@ -208,7 +209,7 @@ class _Breaker:
     bound view (BatchPoplar1.bind returns a fresh engine per job — the
     views must agree on the serving path)."""
 
-    def __init__(self, kind: str):
+    def __init__(self, kind: str) -> None:
         self.kind = kind
         self.lock = threading.Lock()
         self.state = "device"  # device | probing | host
@@ -237,12 +238,14 @@ class ResilientEngine:
     HostPrepEngine oracle — bit-identical outputs, no device state.
     """
 
-    def __init__(self, inner, probe_fn=None, probe_backoff: Backoff | None = None,
-                 _breaker: _Breaker | None = None):
+    def __init__(self, inner: Any,
+                 probe_fn: Callable[[], None] | None = None,
+                 probe_backoff: Backoff | None = None,
+                 _breaker: _Breaker | None = None) -> None:
         self.inner = inner
         self._probe_fn = probe_fn or _runtime_probe
         self._probe_backoff = probe_backoff
-        self._oracle = None
+        self._oracle: Any = None
         self._oracle_lock = threading.Lock()
         if _breaker is not None:
             self._breaker = _breaker
@@ -255,7 +258,7 @@ class ResilientEngine:
     # -- facade ------------------------------------------------------------
 
     @property
-    def vdaf(self):
+    def vdaf(self) -> Any:
         return self.inner.vdaf
 
     @property
@@ -273,19 +276,19 @@ class ResilientEngine:
         return bool(getattr(self.inner, "device_ok", False))
 
     @property
-    def fallback_count(self):
+    def fallback_count(self) -> int:
         return self.inner.fallback_count
 
     @property
-    def timings(self):
+    def timings(self) -> Any:
         return getattr(self.inner, "timings", {})
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> Any:
         # non-guarded surface (field/flp introspection, _host_helper,
         # lane_upload_bytes, compiled-kernel caches for /debug/state)
         return getattr(self.inner, name)
 
-    def oracle(self):
+    def oracle(self) -> Any:
         """The degraded-mode serving path: a HostPrepEngine over the SAME
         vdaf instance, so prepare transcripts and aggregates are
         byte-identical to the device path (the parity property the
@@ -297,7 +300,7 @@ class ResilientEngine:
                 self._oracle = HostPrepEngine(self.inner.vdaf)
             return self._oracle
 
-    def bind(self, agg_param: bytes):
+    def bind(self, agg_param: bytes) -> "ResilientEngine":
         bound = self.inner.bind(agg_param)
         if bound is self.inner:
             return self
@@ -422,7 +425,8 @@ class ResilientEngine:
                 f">= JANUS_ENGINE_FALLBACK_TRIP={limit}"),
                 where="fallback_trip")
 
-    def _call_inner(self, fn, args):
+    def _call_inner(self, fn: Callable[..., Any],
+                    args: tuple[Any, ...]) -> Any:
         """Invoke an inner entry point, optionally under a launch-timeout
         watchdog thread (JANUS_ENGINE_LAUNCH_TIMEOUT_S; default off — the
         device path is synchronous and a guard thread per launch is not
@@ -430,9 +434,9 @@ class ResilientEngine:
         timeout = _env_float("JANUS_ENGINE_LAUNCH_TIMEOUT_S", 0.0)
         if timeout <= 0:
             return fn(*args)
-        result: dict = {}
+        result: dict[str, Any] = {}
 
-        def work():
+        def work() -> None:
             try:
                 result["value"] = fn(*args)
             except BaseException as e:  # noqa: BLE001 — delivered to caller
@@ -451,7 +455,8 @@ class ResilientEngine:
 
     # -- guarded entry points ---------------------------------------------
 
-    def _guarded(self, name: str, n: int, args: tuple):
+    def _guarded(self, name: str, n: int,
+                 args: tuple[Any, ...]) -> Any:
         """Serve `name` via the device path with demotion-on-failure, or
         via the oracle when the breaker is open.  The call that observes
         the failure is itself re-served on the oracle: zero loss."""
@@ -472,7 +477,8 @@ class ResilientEngine:
         self._check_fallback_trip()
         return out
 
-    def _oracle_retry(self, name: str, args: tuple):
+    def _oracle_retry(self, name: str,
+                      args: tuple[Any, ...]) -> Any:
         try:
             return getattr(self.oracle(), name)(*args)
         except BaseException as e:
@@ -481,32 +487,33 @@ class ResilientEngine:
             raise_if_backend_error(e)
             raise
 
-    def helper_init_batch(self, verify_key, nonces, public_shares,
-                          input_shares, inbound_messages):
+    def helper_init_batch(self, verify_key: Any, nonces: Any,
+                          public_shares: Any, input_shares: Any,
+                          inbound_messages: Any) -> Any:
         return self._guarded(
             "helper_init_batch", len(nonces),
             (verify_key, nonces, public_shares, input_shares,
              inbound_messages))
 
-    def leader_init_batch(self, verify_key, nonces, public_shares,
-                          input_shares):
+    def leader_init_batch(self, verify_key: Any, nonces: Any,
+                          public_shares: Any, input_shares: Any) -> Any:
         return self._guarded(
             "leader_init_batch", len(nonces),
             (verify_key, nonces, public_shares, input_shares))
 
-    def leader_finish(self, reports, inbound_messages):
+    def leader_finish(self, reports: Any, inbound_messages: Any) -> Any:
         # host-side seed compare on both engines; route by breaker so a
         # demoted engine never touches inner (whose lazy device constants
         # could re-raise), and count it toward the availability SLI
         return self._guarded("leader_finish", len(reports),
                              (reports, inbound_messages))
 
-    def aggregate(self, reports):
+    def aggregate(self, reports: Any) -> Any:
         rows = [rep.out_share_raw for rep in reports
                 if rep.status == "finished" and rep.out_share_raw is not None]
         return self.aggregate_raw_rows(rows)
 
-    def _ints_to_raw(self, row: list):
+    def _ints_to_raw(self, row: list[int]) -> Any:
         """Oracle out_share_raw (list of field ints) -> the device
         engine's [OUTPUT_LEN, LIMBS] little-endian u32 limb layout."""
         limbs = int(getattr(self.inner, "L", 2))
@@ -514,7 +521,7 @@ class ResilientEngine:
                             for k in range(limbs)] for v in row],
                           dtype=np.uint32)
 
-    def aggregate_raw_rows(self, rows):
+    def aggregate_raw_rows(self, rows: Any) -> Any:
         if not self.demoted and backend_loss_active():
             self._trip(_chaos_error(), where="aggregate_raw_rows")
         if self.demoted:
@@ -539,7 +546,7 @@ class ResilientEngine:
 
     # -- device-resident operations (no oracle equivalent) -----------------
 
-    def _device_only(self, name: str, args: tuple):
+    def _device_only(self, name: str, args: tuple[Any, ...]) -> Any:
         """Masked HBM reduces operate on device-resident share arrays; a
         dead backend means those arrays are gone.  Raise the typed error
         so the job driver's lease retry re-prepares — by then the breaker
@@ -559,13 +566,13 @@ class ResilientEngine:
                 raise_if_backend_error(e)
             raise
 
-    def aggregate_masked_launch(self, shares, mask):
+    def aggregate_masked_launch(self, shares: Any, mask: Any) -> Any:
         return self._device_only("aggregate_masked_launch", (shares, mask))
 
-    def aggregate_resolve(self, handle):
+    def aggregate_resolve(self, handle: Any) -> Any:
         return self._device_only("aggregate_resolve", (handle,))
 
-    def aggregate_masked(self, shares, mask):
+    def aggregate_masked(self, shares: Any, mask: Any) -> Any:
         return self._device_only("aggregate_masked", (shares, mask))
 
 
@@ -576,15 +583,15 @@ _engines: "weakref.WeakSet[ResilientEngine]" = weakref.WeakSet()
 _engines_lock = threading.Lock()
 
 
-def _registered_engines() -> list:
+def _registered_engines() -> list["ResilientEngine"]:
     with _engines_lock:
         return list(_engines)
 
 
-def engines_snapshot() -> list[dict]:
+def engines_snapshot() -> list[dict[str, Any]]:
     """Per-engine breaker state for /debug/watchdog and the soak scraper:
     demote + re-promote cycles must be operator-visible."""
-    out = []
+    out: list[dict[str, Any]] = []
     now = time.monotonic()
     for eng in _registered_engines():
         try:

@@ -28,6 +28,7 @@ Reference analog: the job-driver concurrency coalescing of SURVEY
 from __future__ import annotations
 
 import threading
+from typing import Any
 
 from janus_tpu import metrics
 
@@ -52,7 +53,8 @@ class LinkBandwidthEstimator:
     below what bulk transfers actually sustain.
     """
 
-    def __init__(self, alpha: float = 0.3, min_bytes: int = 262144):
+    def __init__(self, alpha: float = 0.3,
+                 min_bytes: int = 262144) -> None:
         self._alpha = alpha
         self._min_bytes = min_bytes
         self._lock = threading.Lock()
@@ -103,7 +105,7 @@ class LinkBandwidthEstimator:
         with self._lock:
             return self._down
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict[str, Any]:
         with self._lock:
             return {
                 "up_bytes_per_sec": round(self._up, 1) if self._up else None,

@@ -14,18 +14,21 @@ import json
 import os
 import platform
 import sys
+from typing import Any, Iterable, Sequence
 
 from janus_tpu.loadgen.faults import ACCEPTANCE_BURNING
 
 
-def percentiles(samples, qs=(0.5, 0.99, 0.999)) -> dict | None:
+def percentiles(samples: Sequence[float],
+                qs: Sequence[float] = (0.5, 0.99, 0.999)
+                ) -> dict[str, Any] | None:
     """Interpolated percentiles of raw samples: {"p50": .., "p99": ..,
     "p999": .., "count": n}; None when empty."""
     if not samples:
         return None
     ordered = sorted(samples)
     n = len(ordered)
-    out = {}
+    out: dict[str, Any] = {}
     for q in qs:
         pos = q * (n - 1)
         lo = int(pos)
@@ -37,7 +40,8 @@ def percentiles(samples, qs=(0.5, 0.99, 0.999)) -> dict | None:
     return out
 
 
-def _timeline(outcomes, duration_s: float, buckets: int = 10) -> list:
+def _timeline(outcomes: Iterable[Any], duration_s: float,
+              buckets: int = 10) -> list[dict[str, Any]]:
     """Per-slice accepted/rejected/error counts — the sustained-rate
     shape (a diurnal run shows the ramp here)."""
     width = duration_s / buckets
@@ -55,18 +59,18 @@ def _timeline(outcomes, duration_s: float, buckets: int = 10) -> list:
     return rows
 
 
-def _alert_analysis(slo_series: dict) -> dict:
+def _alert_analysis(slo_series: dict[str, Any]) -> dict[str, Any]:
     """Fired/cleared timestamps per SLI from the scraped burn-rate
     trajectories, taking the worst burn across services at each tick
     (the composed topology runs one engine per process)."""
-    merged: dict = {}
+    merged: dict[str, list[tuple[Any, ...]]] = {}
     for points in slo_series.values():
         for p in points:
             for sli, v in p.get("slos", {}).items():
                 merged.setdefault(sli, []).append(
                     (p["t"], v.get("fast_burn"), v.get("slow_burn"),
                      bool(v.get("alerting"))))
-    analysis = {}
+    analysis: dict[str, Any] = {}
     for sli, rows in merged.items():
         rows.sort(key=lambda r: r[0])
         fired_at = cleared_at = None
@@ -92,7 +96,7 @@ def _alert_analysis(slo_series: dict) -> dict:
     return analysis
 
 
-def _degraded_analysis(engine_series: list) -> dict:
+def _degraded_analysis(engine_series: list[Any]) -> dict[str, Any]:
     """Demote/re-promote windows per (service, engine kind) from the
     scraped breaker-state trajectory, plus the final counters — the
     chaos-smoke gate reads `demotions`/`repromotions` from here.
@@ -101,9 +105,9 @@ def _degraded_analysis(engine_series: list) -> dict:
     tick that observed the engine demoted, `repromoted_at_s` the first
     tick after it returned to the device path (None if still demoted at
     run end)."""
-    windows: list = []
-    open_at: dict = {}    # (service, kind) -> first demoted tick
-    final: dict = {}      # (service, kind) -> last engine snapshot
+    windows: list[dict[str, Any]] = []
+    open_at: dict[tuple[Any, Any], Any] = {}  # (service, kind) -> 1st tick
+    final: dict[tuple[Any, Any], Any] = {}    # (service, kind) -> snapshot
     for point in engine_series:
         t, svc = point["t"], point["service"]
         for eng in point.get("engines", []):
@@ -133,10 +137,12 @@ def _degraded_analysis(engine_series: list) -> dict:
     }
 
 
-def build_artifact(*, config: dict, generator, scraper, audit: dict,
+def build_artifact(*, config: dict[str, Any], generator: Any, scraper: Any,
+                   audit: dict[str, Any],
                    acceptance_objective: float = 0.99,
-                   burn_alert: float = 2.0, collections: list | None = None,
-                   wall_s: float | None = None) -> dict:
+                   burn_alert: float = 2.0,
+                   collections: list[Any] | None = None,
+                   wall_s: float | None = None) -> dict[str, Any]:
     """Assemble the artifact dict from a finished run's pieces."""
     summary = generator.summary()
     upload_latencies = [o.latency_s for o in generator.outcomes
@@ -220,7 +226,7 @@ def next_artifact_path(repo_dir: str, prefix: str = "SOAK") -> str:
         n += 1
 
 
-def write_artifact(artifact: dict, path: str) -> str:
+def write_artifact(artifact: dict[str, Any], path: str) -> str:
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1, sort_keys=False)
         f.write("\n")

@@ -10,11 +10,15 @@ aggregation job driver, collected in the collection job driver).
 
 from __future__ import annotations
 
+from typing import Any
+
 from janus_tpu import funnel
 
 
-def funnel_conservation_audit(service_funnels, final: bool = True,
-                              uploaded_expected: int | None = None) -> dict:
+def funnel_conservation_audit(service_funnels: list[dict[str, Any]],
+                              final: bool = True,
+                              uploaded_expected: int | None = None
+                              ) -> dict[str, Any]:
     """Join the per-service ``/debug/funnel`` ``tasks`` payloads and run
     the conservation audit.
 

@@ -31,6 +31,10 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, fields
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import threading
 
 from janus_tpu.messages import HpkeCiphertext, Report
 
@@ -81,7 +85,7 @@ class FaultInjector:
     """
 
     def __init__(self, fraction: float, mix: FaultMix, rng: random.Random,
-                 window: tuple[float, float] = (0.0, 1.0)):
+                 window: tuple[float, float] = (0.0, 1.0)) -> None:
         if not 0.0 <= fraction <= 1.0:
             raise ValueError("fraction must be in [0, 1]")
         self.fraction = fraction
@@ -116,13 +120,13 @@ class BackendLossInjector:
     scheduled relative to ``arm()``.
     """
 
-    def __init__(self, start_s: float, end_s: float):
+    def __init__(self, start_s: float, end_s: float) -> None:
         if not 0.0 <= start_s < end_s:
             raise ValueError("backend-loss window must satisfy "
                              "0 <= start < end")
         self.start_s = start_s
         self.end_s = end_s
-        self._timers: list = []
+        self._timers: list["threading.Timer"] = []
         self.injected_at: float | None = None
         self.lifted_at: float | None = None
 
@@ -134,11 +138,11 @@ class BackendLossInjector:
 
         t0 = time.monotonic()
 
-        def poison():
+        def poison() -> None:
             self.injected_at = round(time.monotonic() - t0, 3)
             resilient.inject_backend_loss()
 
-        def lift():
+        def lift() -> None:
             self.lifted_at = round(time.monotonic() - t0, 3)
             resilient.lift_backend_loss()
 

@@ -25,7 +25,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import Any, Callable
 
 from janus_tpu.loadgen.faults import FaultInjector, FaultMix, tamper_leader_ciphertext
 from janus_tpu.loadgen.schedule import make_schedule
@@ -35,7 +35,7 @@ from janus_tpu.messages import Duration, Report
 class UploadRejected(Exception):
     """The leader turned the upload away with a problem document."""
 
-    def __init__(self, reason: str, status: int):
+    def __init__(self, reason: str, status: int) -> None:
         super().__init__(f"{reason} (HTTP {status})")
         self.reason = reason
         self.status = status
@@ -48,13 +48,13 @@ class HttpUploader:
     thread lazily gets its own keep-alive session.
     """
 
-    def __init__(self, leader_endpoint: str, task_id):
+    def __init__(self, leader_endpoint: str, task_id: Any) -> None:
         self.task_id = task_id
         self.url = (leader_endpoint.rstrip("/")
                     + f"/tasks/{task_id}/reports")
         self._local = threading.local()
 
-    def _session(self):
+    def _session(self) -> Any:
         session = getattr(self._local, "session", None)
         if session is None:
             import requests
@@ -84,16 +84,16 @@ class TaskWorkload:
     mutations need."""
 
     name: str
-    client: object  # janus_tpu.client.Client with HPKE configs resolved
+    client: Any  # janus_tpu.client.Client with HPKE configs resolved
     upload: Callable[[bytes], None]
-    measure: Callable[[random.Random], object]
+    measure: Callable[[random.Random], Any]
     time_precision_s: int
     tolerable_clock_skew_s: int
     report_expiry_age_s: int | None = None
     replay_capacity: int = 256
 
-    def __post_init__(self):
-        self._replays: collections.deque = collections.deque(  # janus-lint: disable=guarded-write-unlocked -- field construction; no other thread holds a reference yet
+    def __post_init__(self) -> None:
+        self._replays: collections.deque[bytes] = collections.deque(  # janus-lint: disable=guarded-write-unlocked -- field construction; no other thread holds a reference yet
             maxlen=self.replay_capacity)
         self._replay_lock = threading.Lock()
 
@@ -126,7 +126,7 @@ class LoadConfig:
     schedule: str = "poisson"
     fault_fraction: float = 0.0
     fault_mix: FaultMix = field(default_factory=FaultMix)
-    fault_window: tuple = (0.0, 1.0)
+    fault_window: tuple[float, float] = (0.0, 1.0)
     workers: int = 16
     seed: int = 1
 
@@ -135,13 +135,14 @@ class LoadGenerator:
     """Drives the workload matrix per ``LoadConfig``; ``run()`` blocks
     until the schedule is exhausted and every in-flight upload resolved."""
 
-    def __init__(self, config: LoadConfig, workloads: list):
+    def __init__(self, config: LoadConfig,
+                 workloads: list[TaskWorkload]) -> None:
         if not workloads:
             raise ValueError("need at least one TaskWorkload")
         self.config = config
         self.workloads = list(workloads)
         self.outcomes: list[UploadOutcome] = []
-        self.injected: collections.Counter = collections.Counter()
+        self.injected: collections.Counter[str] = collections.Counter()
         self.offered = 0
         self.max_lag_s = 0.0  # worst arrival-loop scheduling slip
         self._lock = threading.Lock()
@@ -183,8 +184,9 @@ class LoadGenerator:
 
     # -- one upload --------------------------------------------------------
 
-    def _one_upload(self, workload: TaskWorkload, measurement, fault,
-                    offset: float, rng: random.Random) -> None:
+    def _one_upload(self, workload: TaskWorkload, measurement: Any,
+                    fault: str | None, offset: float,
+                    rng: random.Random) -> None:
         applied = fault
         body = None
         try:
@@ -214,8 +216,8 @@ class LoadGenerator:
             workload.remember_accepted(body)
         self._record(offset, workload.name, applied, status, latency)
 
-    def _build_report(self, workload: TaskWorkload, measurement,
-                      fault) -> bytes:
+    def _build_report(self, workload: TaskWorkload, measurement: Any,
+                      fault: str | None) -> bytes:
         client = workload.client
         report_time = None
         if fault == "expired":
@@ -234,8 +236,8 @@ class LoadGenerator:
             report = tamper_leader_ciphertext(report)
         return report.encode()
 
-    def _record(self, offset: float, task: str, fault, status: str,
-                latency_s: float) -> None:
+    def _record(self, offset: float, task: str, fault: str | None,
+                status: str, latency_s: float) -> None:
         with self._lock:
             self.outcomes.append(UploadOutcome(
                 round(offset, 4), task, fault, status, round(latency_s, 6)))
@@ -244,12 +246,12 @@ class LoadGenerator:
 
     # -- post-run accounting ----------------------------------------------
 
-    def summary(self) -> dict:
+    def summary(self) -> dict[str, Any]:
         with self._lock:
             outcomes = list(self.outcomes)
             injected = dict(self.injected)
-        by_status: collections.Counter = collections.Counter()
-        by_fault_status: dict = {}
+        by_status: collections.Counter[str] = collections.Counter()
+        by_fault_status: dict[str, collections.Counter[str]] = {}
         for o in outcomes:
             by_status[o.status] += 1
             if o.fault is not None:

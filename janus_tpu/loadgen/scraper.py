@@ -22,6 +22,7 @@ from __future__ import annotations
 import re
 import threading
 import time
+from typing import Any, Iterable, Sequence
 
 _BUCKET_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{(?P<labels>[^}]*)\}'
@@ -31,7 +32,9 @@ _SUM_COUNT_RE = re.compile(
     r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[0-9.eE+-]+)\s*$')
 
 
-def parse_histogram(text: str, name: str):
+def parse_histogram(
+        text: str, name: str
+) -> tuple[list[float], list[int], float, int] | None:
     """Sum a histogram across its label sets in a Prometheus exposition.
 
     Returns ``(bounds, counts, total_sum, total_count)`` where ``counts``
@@ -40,7 +43,7 @@ def parse_histogram(text: str, name: str):
     None when the metric is absent.
     """
     # per label set: {le: cumulative}
-    by_labels: dict = {}
+    by_labels: dict[str, dict[float, float]] = {}
     total_sum = 0.0
     total_count = 0
     seen = False
@@ -88,7 +91,8 @@ def parse_histogram(text: str, name: str):
 class Scraper(threading.Thread):
     """Polls ``services`` (name, base_url pairs) every ``interval_s``."""
 
-    def __init__(self, services, interval_s: float = 1.0):
+    def __init__(self, services: Iterable[tuple[str, str]],
+                 interval_s: float = 1.0) -> None:
         super().__init__(name="soak-scraper", daemon=True)
         self.services = list(services)
         self.interval_s = interval_s
@@ -96,21 +100,22 @@ class Scraper(threading.Thread):
         self._session_local = threading.local()
         self._t0 = time.monotonic()
         # results
-        self.slo_series: dict = {name: [] for name, _ in self.services}
-        self.funnel_last: dict = {}    # service -> /debug/funnel "tasks"
-        self.watchdog_last: dict = {}  # service -> last verdict
-        self.stall_events: list = []   # [{"t", "service", "stalls"}]
+        self.slo_series: dict[str, list[dict[str, Any]]] = {
+            name: [] for name, _ in self.services}
+        self.funnel_last: dict[str, Any] = {}   # service -> funnel "tasks"
+        self.watchdog_last: dict[str, Any] = {}  # service -> last verdict
+        self.stall_events: list[dict[str, Any]] = []
         # breaker-state trajectory from the watchdog payload's "engines"
         # section: [{"t", "service", "engines": [{kind, state, ...}]}] —
         # the artifact derives demote/re-promote windows from this
-        self.engine_series: list = []
-        self.metrics_last: dict = {}   # service -> exposition text
+        self.engine_series: list[dict[str, Any]] = []
+        self.metrics_last: dict[str, str] = {}  # service -> exposition
         self.scrapes = 0
-        self.errors: dict = {}         # service -> error count
+        self.errors: dict[str, int] = {}        # service -> error count
 
     # -- plumbing ----------------------------------------------------------
 
-    def _session(self):
+    def _session(self) -> Any:
         s = getattr(self._session_local, "session", None)
         if s is None:
             import requests
@@ -118,7 +123,8 @@ class Scraper(threading.Thread):
             s = self._session_local.session = requests.Session()
         return s
 
-    def _get(self, base: str, path: str, json_body: bool = True):
+    def _get(self, base: str, path: str,
+             json_body: bool = True) -> Any:
         resp = self._session().get(base.rstrip("/") + path, timeout=10)
         resp.raise_for_status()
         return resp.json() if json_body else resp.text
@@ -149,7 +155,8 @@ class Scraper(threading.Thread):
         self.metrics_last[name] = self._get(base, "/metrics",
                                             json_body=False)
         slo = self._get(base, "/debug/slo")
-        point = {"t": t, "alerting": slo.get("alerting", []), "slos": {}}
+        point: dict[str, Any] = {
+            "t": t, "alerting": slo.get("alerting", []), "slos": {}}
         for sli, obj in (slo.get("slos") or {}).items():
             windows = obj.get("windows", {})
             point["slos"][sli] = {
@@ -172,18 +179,21 @@ class Scraper(threading.Thread):
 
     # -- derived views -----------------------------------------------------
 
-    def merged_funnel(self) -> dict:
+    def merged_funnel(self) -> dict[str, Any]:
         from janus_tpu import funnel
 
         return funnel.merge_snapshots(self.funnel_last.values())
 
-    def latency_quantiles(self, metric: str, quantiles=(0.5, 0.99, 0.999)):
+    def latency_quantiles(
+            self, metric: str,
+            quantiles: Sequence[float] = (0.5, 0.99, 0.999),
+    ) -> dict[str, float] | None:
         """Cross-service percentile estimates for a histogram metric,
         interpolated from the summed bucket counts of the LAST scrape."""
         from janus_tpu.slo import _quantile
 
-        bounds: list = []
-        counts: list = []
+        bounds: list[float] = []
+        counts: list[int] = []
         for text in self.metrics_last.values():
             parsed = parse_histogram(text, metric)
             if parsed is None:

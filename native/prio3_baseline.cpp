@@ -35,8 +35,8 @@ typedef uint8_t u8;
 // Montgomery CIOS (p === 1 mod 2^64, so n' = -p^{-1} = 2^64 - 1).
 // ---------------------------------------------------------------------------
 
-static const u64 P_HI = 0xFFFFFFFFFFFFFFE4ull;
-static const u64 P_LO = 0x0000000000000001ull;
+static const u64 P_HI = 0xFFFFFFFFFFFFFFE4ULL;
+static const u64 P_LO = 0x0000000000000001ULL;
 static inline u128 P() { return ((u128)P_HI << 64) | P_LO; }
 
 static inline u128 fadd(u128 a, u128 b) {
@@ -181,14 +181,14 @@ static Fp poly_eval(const std::vector<Fp>& c, Fp x) {
 // ---------------------------------------------------------------------------
 
 static const u64 RC[24] = {
-    0x0000000000000001ull, 0x0000000000008082ull, 0x800000000000808aull,
-    0x8000000080008000ull, 0x000000000000808bull, 0x0000000080000001ull,
-    0x8000000080008081ull, 0x8000000000008009ull, 0x000000000000008aull,
-    0x0000000000000088ull, 0x0000000080008009ull, 0x000000008000000aull,
-    0x000000008000808bull, 0x800000000000008bull, 0x8000000000008089ull,
-    0x8000000000008003ull, 0x8000000000008002ull, 0x8000000000000080ull,
-    0x000000000000800aull, 0x800000008000000aull, 0x8000000080008081ull,
-    0x8000000000008080ull, 0x0000000080000001ull, 0x8000000080008008ull};
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
 
 static const int ROT[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3,  10, 43,
                             25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56, 14};

@@ -426,6 +426,460 @@ def check(tag, expected_tag):
     assert "nonconstant-compare" in rules
 
 
+# -- interprocedural dataflow (dataflow.py over callgraph.py) ---------------
+
+def dataflow_findings(files, root=None):
+    # synthetic fixture paths ("pkg/...") don't exist on disk, so package
+    # root inference can't see __init__.py markers — anchor explicitly
+    from janus_lint import callgraph, dataflow
+    repo = callgraph.build_repo(files, root=root) if root else None
+    return dataflow.check_repo(files, repo=repo)
+
+
+def dataflow_rules(files, root=None):
+    return [f.rule for f in dataflow_findings(files, root=root)]
+
+
+BAD_TAINT_HELPER = """
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def fetch_key(cfg):
+    return cfg.private_key
+
+
+def handle(cfg):
+    k = fetch_key(cfg)
+    log.info("loaded key %s", k)
+"""
+
+GOOD_TAINT_SANITIZED = """
+import hashlib
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def fetch_key(cfg):
+    return cfg.private_key
+
+
+def handle(cfg):
+    k = fetch_key(cfg)
+    log.info("loaded key %s", hashlib.sha256(k).hexdigest())
+"""
+
+
+def test_secret_leak_through_helper_return():
+    """The secret crosses a function boundary (helper return) before the
+    sink — exactly what PR 7's single-module pass cannot see."""
+    fs = dataflow_findings([("janus_tpu/core/kx.py", BAD_TAINT_HELPER)])
+    assert [f.rule for f in fs] == ["secret-leak"]
+    assert "log line" in fs[0].message
+
+
+def test_secret_leak_cut_by_sanitizer():
+    assert dataflow_rules(
+        [("janus_tpu/core/kx.py", GOOD_TAINT_SANITIZED)]) == []
+
+
+BAD_RETRACE = """
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x, n):
+    return x * n
+
+
+_run = jax.jit(_kernel, static_argnums=(1,))
+
+
+def _count(reports):
+    return len(reports)
+
+
+def step(x, reports):
+    n = _count(reports)
+    return _run(x, n)
+"""
+
+GOOD_RETRACE = """
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x, n):
+    return x * n
+
+
+_run = jax.jit(_kernel, static_argnums=(1,))
+
+
+def _count(reports):
+    return len(reports)
+
+
+def _bucket(n):
+    return 1 << max(4, (n - 1).bit_length())
+
+
+def step(x, reports):
+    n = _bucket(_count(reports))
+    return _run(x, n)
+"""
+
+
+def test_retrace_via_transitive_size():
+    """len(reports) flows through a helper return into a static jit key."""
+    rules = dataflow_rules([("janus_tpu/engine/stepper.py", BAD_RETRACE)])
+    assert "retrace-storm" in rules
+
+
+def test_retrace_cut_by_bucketing():
+    assert dataflow_rules(
+        [("janus_tpu/engine/stepper.py", GOOD_RETRACE)]) == []
+
+
+HOT_CALLER = """
+from janus_tpu.scalar_util import flush_scalar
+
+
+def drive(x):
+    return flush_scalar(x)
+"""
+
+SYNC_HELPER = """
+def flush_scalar(x):
+    return x.item()
+"""
+
+PURE_HELPER = """
+def flush_scalar(x):
+    return x
+"""
+
+
+def test_transitive_host_sync_across_modules():
+    fs = dataflow_findings([
+        ("janus_tpu/engine/driver.py", HOT_CALLER),
+        ("janus_tpu/scalar_util.py", SYNC_HELPER),
+    ])
+    assert [f.rule for f in fs] == ["transitive-host-sync"]
+    assert fs[0].path == "janus_tpu/engine/driver.py"
+    assert ".item()" in fs[0].message
+
+
+def test_transitive_host_sync_clean_helper():
+    assert dataflow_rules([
+        ("janus_tpu/engine/driver.py", HOT_CALLER),
+        ("janus_tpu/scalar_util.py", PURE_HELPER),
+    ]) == []
+
+
+BAD_LOCKED_HELPER = """
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def _drain_locked(self):
+        out = list(self._items)
+        del self._items[:]
+        return out
+
+    def broken(self):
+        return self._drain_locked()
+"""
+
+GOOD_LOCKED_HELPER = """
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def _drain_locked(self):
+        out = list(self._items)
+        del self._items[:]
+        return out
+
+    def flush(self):
+        with self._lock:
+            return self._drain_locked()
+"""
+
+
+def test_locked_helper_called_unheld():
+    fs = dataflow_findings([("janus_tpu/aggregator/q.py", BAD_LOCKED_HELPER)])
+    assert [f.rule for f in fs] == ["locked-helper-unheld"]
+    assert "broken" in fs[0].message
+
+
+def test_locked_helper_called_held():
+    assert dataflow_rules(
+        [("janus_tpu/aggregator/q.py", GOOD_LOCKED_HELPER)]) == []
+
+
+BAD_REACQUIRE = """
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def outer(self):
+        with self._lock:
+            self.bump()
+"""
+
+GOOD_REACQUIRE = BAD_REACQUIRE.replace("threading.Lock()",
+                                       "threading.RLock()")
+
+
+def test_lock_held_reacquire():
+    rules = dataflow_rules([("janus_tpu/aggregator/c.py", BAD_REACQUIRE)])
+    assert "lock-held-reacquire" in rules
+
+
+def test_lock_held_reacquire_rlock_ok():
+    assert "lock-held-reacquire" not in dataflow_rules(
+        [("janus_tpu/aggregator/c.py", GOOD_REACQUIRE)])
+
+
+CYCLE_M1 = """
+import threading
+
+from pkg import m2
+
+A = threading.Lock()
+
+
+def use_a_then_b():
+    with A:
+        m2.locked_b_work()
+
+
+def a_work():
+    with A:
+        pass
+"""
+
+CYCLE_M2 = """
+import threading
+
+from pkg import m1
+
+B = threading.Lock()
+
+
+def locked_b_work():
+    with B:
+        pass
+
+
+def use_b_then_a():
+    with B:
+        m1.a_work()
+"""
+
+NOCYCLE_M2 = """
+import threading
+
+from pkg import m1
+
+B = threading.Lock()
+
+
+def locked_b_work():
+    with B:
+        pass
+
+
+def also_a_then_b():
+    m1.use_a_then_b()
+"""
+
+
+def test_cross_module_lock_order_cycle():
+    """A -> B in m1 and B -> A in m2; both edges exist only through a
+    call, so the syntactic per-module inversion pass cannot see them."""
+    rules = dataflow_rules([("pkg/m1.py", CYCLE_M1),
+                            ("pkg/m2.py", CYCLE_M2)], root=".")
+    assert "lock-order-cycle" in rules
+
+
+def test_consistent_lock_order_no_cycle():
+    assert "lock-order-cycle" not in dataflow_rules(
+        [("pkg/m1.py", CYCLE_M1), ("pkg/m2.py", NOCYCLE_M2)], root=".")
+
+
+BAD_GLOBAL_WRITE = """
+import threading
+
+COUNT = 0
+
+
+def bump():
+    global COUNT
+    COUNT += 1
+
+
+def worker_loop():
+    bump()
+
+
+def serve():
+    threading.Thread(target=worker_loop, name="dispatcher").start()
+    bump()
+"""
+
+GOOD_GLOBAL_WRITE = """
+import threading
+
+COUNT = 0
+_count_lock = threading.Lock()
+
+
+def bump():
+    global COUNT
+    with _count_lock:
+        COUNT += 1
+
+
+def worker_loop():
+    bump()
+
+
+def serve():
+    threading.Thread(target=worker_loop, name="dispatcher").start()
+    bump()
+"""
+
+
+def test_unlocked_global_write_two_roles():
+    """bump() runs on both the spawning (request) path and the spawned
+    dispatcher thread; the unlocked increment is a lost-update race."""
+    fs = dataflow_findings([("pkg/gw.py", BAD_GLOBAL_WRITE)])
+    assert [f.rule for f in fs] == ["unlocked-global-write"]
+    assert "COUNT" in fs[0].message
+
+
+def test_locked_global_write_ok():
+    assert dataflow_rules([("pkg/gw.py", GOOD_GLOBAL_WRITE)]) == []
+
+
+def test_lint_source_dataflow_flag_and_suppression():
+    res = lint_source(BAD_TAINT_HELPER, path="janus_tpu/core/kx.py",
+                      _dataflow=True)
+    assert "secret-leak" in [f.rule for f in res.active]
+    sup = BAD_TAINT_HELPER.replace(
+        "    log.info",
+        "    # janus-lint: disable=secret-leak -- test fixture\n"
+        "    log.info")
+    res = lint_source(sup, path="janus_tpu/core/kx.py", _dataflow=True)
+    assert [f.rule for f in res.active] == []
+    assert [f.rule for f in res.suppressed] == ["secret-leak"]
+
+
+# -- the call graph ----------------------------------------------------------
+
+CG_ALPHA = """
+import threading
+
+import jax
+
+from pkg.beta import Codec
+
+
+def helper(x):
+    return x + 1
+
+
+def kern(x):
+    return x
+
+
+def build():
+    return jax.jit(kern)
+
+
+def top(x):
+    c = Codec()
+    c.encode(x)
+    return helper(x)
+
+
+def spin():
+    threading.Thread(target=top, name="probe-1").start()
+"""
+
+CG_BETA = """
+class Codec:
+    def encode(self, x):
+        return self._pack(x)
+
+    def _pack(self, x):
+        return x
+
+
+class Router:
+    def handle(self, name):
+        return getattr(self, "r_get")()
+
+    def r_get(self):
+        return 1
+"""
+
+
+def test_callgraph_synthetic_package():
+    from janus_lint import callgraph
+
+    repo = callgraph.build_repo([("pkg/alpha.py", CG_ALPHA),
+                                 ("pkg/beta.py", CG_BETA)], root=".")
+
+    def edges(qual):
+        return {(s.callee, s.kind) for s in repo.calls.get(qual, ())}
+
+    # name-resolved direct call + method via local ClassName() binding
+    top = edges("pkg.alpha.top")
+    assert ("pkg.alpha.helper", "call") in top
+    assert ("pkg.beta.Codec.encode", "call") in top
+    # self-method resolution inside the class
+    assert ("pkg.beta.Codec._pack", "call") in edges("pkg.beta.Codec.encode")
+    # first-order callbacks: jit wrap and thread spawn, kind-tagged
+    assert ("pkg.alpha.kern", "jit") in edges("pkg.alpha.build")
+    assert ("pkg.alpha.top", "thread") in edges("pkg.alpha.spin")
+    # thread role inferred from the spawn site's name= kwarg
+    assert repo.thread_roles["pkg.alpha.top"] == "probe"
+    # getattr dispatch: constant name resolves to the receiver method
+    assert ("pkg.beta.Router.r_get", "call") in edges("pkg.beta.Router.handle")
+    # reverse index mirrors the forward edges
+    callers = {s.caller for s in repo.callers.get("pkg.alpha.helper", ())}
+    assert "pkg.alpha.top" in callers
+
+
 # -- the repo-wide gate ------------------------------------------------------
 
 def test_repo_is_lint_clean():
