@@ -646,6 +646,81 @@ def _cpu_fallback_if_backend_error(e: BaseException) -> None:
         _reexec_on_cpu(f"device backend failed mid-run ({type(e).__name__})")
 
 
+def bench_dp_histogram(smoke: bool) -> dict:
+    """Collection-path section: merge Prio3Histogram 4096-bucket shard
+    accumulators on device (engine/merge.py), add discrete-Gaussian DP
+    noise to the merged aggregate share (janus_tpu/dp), re-encode.  This
+    is the leader's compute_aggregate_share hot path with DP enabled;
+    `time_split` attributes merge vs dp_noise vs encode, and the section
+    re-proves device/host-oracle bit parity under a fixed seed."""
+    import random
+
+    from janus_tpu.core.dp import strategy_for
+    from janus_tpu.dp import kernels, samplers
+    from janus_tpu.dp.config import DpParams
+    from janus_tpu.engine.merge import merge_encoded_shares
+
+    buckets = 4096
+    vdaf = prio3.new_histogram(buckets, optimal_chunk_length(buckets))
+    field = vdaf.field
+    n_shards = 8 if smoke else 64
+    iters = 2 if smoke else 8
+    rng = random.Random(20260809)
+    blobs = [field.encode_vec(
+        [rng.randrange(field.MODULUS) for _ in range(buckets)])
+        for _ in range(n_shards)]
+    params = DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+                      delta_exp=30)
+    strategy = strategy_for(params)
+
+    # warmup: pay both kernels' compiles outside the timed loop
+    merged = merge_encoded_shares(vdaf, blobs, force=True)
+    strategy.add_noise_to_agg_share(vdaf, merged, n_shards)
+
+    t_merge = t_noise = t_encode = 0.0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        a = time.perf_counter()
+        merged = merge_encoded_shares(vdaf, blobs, force=True)
+        b = time.perf_counter()
+        noised = strategy.add_noise_to_agg_share(vdaf, merged, n_shards)
+        c = time.perf_counter()
+        vdaf.encode_agg_share(noised)
+        d = time.perf_counter()
+        t_merge += b - a
+        t_noise += c - b
+        t_encode += d - c
+    elapsed = time.perf_counter() - t0
+
+    # fixed-seed parity re-proof on the merged share (acceptance: device
+    # output is bit-identical to the exact-integer host oracle)
+    seed = b"\x2a" * 16
+    table = params.table()
+    h0 = time.perf_counter()
+    host = samplers.add_noise_host(field.MODULUS, merged, table, seed)
+    host_s = time.perf_counter() - h0
+    dev = kernels.add_noise_device(field.ENCODED_SIZE, merged, table, seed)
+
+    total_t = max(t_merge + t_noise + t_encode, 1e-9)
+    sig_num, sig_den = params.sigma()
+    return {
+        # shard accumulators merged+noised per second (the unit of work
+        # on this path is a shard, not a report)
+        "reports_per_sec": round(n_shards * iters / elapsed, 1),
+        "collections_per_sec": round(iters / elapsed, 2),
+        "buckets": buckets,
+        "shards": n_shards,
+        "time_split": {"merge": round(t_merge / total_t, 3),
+                       "dp_noise": round(t_noise / total_t, 3),
+                       "encode": round(t_encode / total_t, 3)},
+        "dp_mechanism": "discrete_gaussian",
+        "dp_sigma": round(sig_num / sig_den, 3),
+        "dp_table_tail": table.tail,
+        "host_oracle_noise_s": round(host_s, 4),
+        "device_host_parity": host == dev,
+    }
+
+
 def main():
     smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
     only = os.environ.get("BENCH_CONFIGS")
@@ -687,6 +762,13 @@ def main():
         except Exception as e:
             _cpu_fallback_if_backend_error(e)
             detail["UploadPlane"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if only is None or "Prio3Histogram4096DP" in only:
+        try:
+            detail["Prio3Histogram4096DP"] = bench_dp_histogram(smoke)
+        except Exception as e:
+            _cpu_fallback_if_backend_error(e)
+            detail["Prio3Histogram4096DP"] = {"error": f"{type(e).__name__}: {e}"}
 
     for name, factory, meas, total, batch in make_configs(smoke):
         if only and name not in only:

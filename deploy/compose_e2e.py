@@ -84,6 +84,8 @@ class TaskSpec:
     time_precision_s: int = 3600
     tolerable_clock_skew_s: int = 600
     report_expiry_age_s: int | None = None
+    # JSON DpParams shape (janus_tpu.dp.config), None = no DP noise
+    dp_config: object = None
     task_id: bytes = field(default_factory=lambda: secrets.token_bytes(32))
     verify_key: bytes = field(default_factory=lambda: secrets.token_bytes(16))
 
@@ -102,6 +104,8 @@ class TaskSpec:
         ]
         if self.report_expiry_age_s is not None:
             lines.append(f"  report_expiry_age: {self.report_expiry_age_s}")
+        if self.dp_config is not None:
+            lines.append(f"  dp_config: {json.dumps(self.dp_config)}")
         lines += [
             f"  collector_hpke_config: {collector_config_b64}",
             "  aggregator_auth_token:",

@@ -557,6 +557,10 @@ _SECRET_NAMES = {
     "verify_key": "secret:verify-key", "vk": "secret:verify-key",
     "vks": "secret:verify-key",
     "joint_rand_seed": "secret:seed",
+    # DP noise seeds / XOF state: knowing the seed lets a collector
+    # subtract the noise draw and de-noise the aggregate
+    "noise_seed": "secret:seed", "dp_seed": "secret:seed",
+    "rng_state": "secret:seed", "xof_state": "secret:seed",
     "token": "secret:token", "bearer_token": "secret:token",
     "auth_token": "secret:token",
     "measurement": "secret:share", "measurements": "secret:share",
@@ -591,6 +595,8 @@ _SECRET_RETURNS = (
     ("AuthenticationToken.random_bearer", "secret:token"),
     ("AuthenticationToken.random_dap_auth", "secret:token"),
     (".auth_tokens.extract_bearer_token", "secret:token"),
+    # a logged DP noise seed de-noises the published aggregate
+    (".dp.strategies.fresh_noise_seed", "secret:seed"),
 )
 
 _LOG_METHODS = {"debug", "info", "warning", "error", "exception",

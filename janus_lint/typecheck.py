@@ -3,8 +3,8 @@ relaxed-strict tier on the engine and load-generation planes.
 
 ``janus_tpu/messages/`` and ``janus_tpu/core/`` are the two packages
 whose bugs corrupt bytes on the wire or keys at rest, so they carry full
-``mypy --strict``.  ``janus_tpu/engine/`` and ``janus_tpu/loadgen/``
-carry the same strictness on their OWN surface (every def fully
+``mypy --strict``.  ``janus_tpu/engine/``, ``janus_tpu/loadgen/`` and
+``janus_tpu/dp/`` carry the same strictness on their OWN surface (every def fully
 annotated, no implicit Optional, strict equality) but relax the checks
 that only measure their neighbours: calls into the intentionally-dynamic
 ``ops/`` / ``vdaf/`` kernels stay allowed (``--allow-untyped-calls``,
@@ -31,7 +31,8 @@ import sys
 from janus_lint import Finding
 
 STRICT_TARGETS = ("janus_tpu/messages", "janus_tpu/core")
-EXTENDED_TARGETS = ("janus_tpu/engine", "janus_tpu/loadgen")
+EXTENDED_TARGETS = ("janus_tpu/engine", "janus_tpu/loadgen",
+                    "janus_tpu/dp")
 EXTENDED_RELAXATIONS = (
     "--allow-untyped-calls",
     "--allow-untyped-decorators",

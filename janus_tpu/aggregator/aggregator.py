@@ -495,6 +495,12 @@ class Aggregator:
         if not tc.vdaf_config.dp_config.dp_mechanism.is_recognized:
             raise err.InvalidTask("unrecognized DP mechanism", task_id)
         try:
+            from janus_tpu.dp.config import DpParams
+            dp_params = DpParams.from_dp_mechanism(
+                tc.vdaf_config.dp_config.dp_mechanism)
+        except ValueError as e:
+            raise err.InvalidTask(f"bad DP mechanism: {e}", task_id) from e
+        try:
             vdaf_instance = tc.vdaf_config.vdaf_type.to_vdaf_instance()
         except ValueError as e:
             raise err.InvalidTask(str(e), task_id) from e
@@ -525,6 +531,7 @@ class Aggregator:
             aggregator_auth_token_hash=AuthenticationTokenHash.of(auth),
             hpke_keys=(),  # taskprov tasks use the global HPKE keys
             taskprov=True,
+            dp_config=dp_params,
         )
 
         def txn(tx):
@@ -1783,6 +1790,15 @@ class Aggregator:
                     f"leader claimed {req.report_count} reports with checksum "
                     f"{bytes(req.checksum).hex()}; helper computed {count} "
                     f"with {bytes(checksum).hex()}", task_id)
+            # DP noise on the helper's share, after the count/checksum
+            # claim is validated (the claim describes the pre-noise
+            # funnel, which stays exact in share-space).  A txn retry
+            # redraws the seed, but the cached-job path above re-serves
+            # one committed noised share, so collectors never see two
+            # noise draws for the same batch.
+            from janus_tpu.core.dp import strategy_for
+            share = strategy_for(task.dp_config).add_noise_to_agg_share(
+                bound_vdaf, share, count)
             asj = m.AggregateShareJob(
                 task_id=task_id, batch_identifier=ident,
                 aggregation_parameter=req.aggregation_parameter,
@@ -1810,23 +1826,45 @@ class Aggregator:
 
 def merge_batch_aggregations(vdaf, shards: list[m.BatchAggregation]):
     """compute_aggregate_share: merge shard accumulators into
-    (share, report_count, checksum, interval) (reference aggregate_share.rs:21)."""
+    (share, report_count, checksum, interval) (reference aggregate_share.rs:21).
+
+    Count/checksum/interval accumulate on the host (cheap scalars); the
+    share merge itself runs batched on device when the shapes qualify
+    (engine/merge.py), falling back to the sequential decode+add fold —
+    both produce identical bytes, field addition being exact and
+    associative.
+    """
+    from janus_tpu.engine.resilient import is_backend_error
     from janus_tpu.messages import ReportIdChecksum
 
-    share = None
     count = 0
     checksum = ReportIdChecksum.zero()
     interval = None
+    blobs = []
     for ba in shards:
         count += ba.report_count
         checksum = checksum.combined(ba.checksum)
         if ba.aggregate_share is not None:
-            part = vdaf.decode_agg_share(ba.aggregate_share)
-            share = part if share is None else vdaf.aggregate_update(share, part)
+            blobs.append(ba.aggregate_share)
         if ba.report_count or ba.aggregate_share is not None:
             interval = (ba.client_timestamp_interval if interval is None
                         else Interval.spanning(interval,
                                                ba.client_timestamp_interval))
+
+    share = None
+    try:
+        from janus_tpu.engine.merge import merge_encoded_shares
+        share = merge_encoded_shares(vdaf, blobs)
+    except ValueError:
+        raise  # out-of-range element: the Python fold would raise too
+    except Exception as e:
+        if not is_backend_error(e):
+            raise
+        share = None  # backend lost mid-launch: host fold below
+    if share is None:
+        for blob in blobs:
+            part = vdaf.decode_agg_share(blob)
+            share = part if share is None else vdaf.aggregate_update(share, part)
     if share is None:
         share = vdaf.aggregate_init()
     return share, count, checksum, interval

@@ -16,7 +16,7 @@ from janus_tpu import flight_recorder, funnel, trace, watchdog
 from janus_tpu.aggregator.aggregator import merge_batch_aggregations
 from janus_tpu.aggregator.http_client import PeerClient, PeerHttpError
 from janus_tpu.aggregator.query_type import logic_for
-from janus_tpu.core.dp import NoDifferentialPrivacy
+from janus_tpu.core.dp import NoDifferentialPrivacy, strategy_for
 from janus_tpu.datastore import models as m
 from janus_tpu.datastore.datastore import Datastore
 from janus_tpu.messages import (
@@ -163,7 +163,10 @@ class CollectionJobDriver:
         if interval is None:
             interval = (logic.to_batch_interval(job.batch_identifier)
                         or Interval(Time(0), Duration(1)))
-        share = self.dp_strategy.add_noise_to_agg_share(vdaf, share, count)
+        # Per-task DP config wins; the driver-wide strategy (binaries
+        # JANUS_DP_DEFAULT knob) covers tasks provisioned without one.
+        strategy = strategy_for(task.dp_config, default=self.dp_strategy)
+        share = strategy.add_noise_to_agg_share(vdaf, share, count)
 
         # Helper exchange (process boundary).
         req = AggregateShareReq(

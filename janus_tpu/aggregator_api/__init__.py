@@ -64,6 +64,8 @@ def _task_resp(task: AggregatorTask) -> dict:
         "collector_hpke_config": (_b64(task.collector_hpke_config.encode())
                                   if task.collector_hpke_config else None),
         "taskprov": task.taskprov,
+        "dp_config": (task.dp_config.to_json_obj()
+                      if task.dp_config is not None else None),
     }
     if task.aggregator_auth_token is not None:
         out["aggregator_auth_token_hash"] = {
@@ -134,6 +136,10 @@ class AggregatorApi:
             if len(verify_key) != vdaf.verify_key_length:
                 raise ApiError(400, "wrong VDAF verify key length")
             query_type = QueryTypeCfg.from_json_obj(body["query_type"])
+            dp_config = None
+            if body.get("dp_config") is not None:
+                from janus_tpu.dp.config import DpParams
+                dp_config = DpParams.from_json_obj(body["dp_config"])
         except (KeyError, ValueError) as e:
             raise ApiError(400, f"bad task request: {e}") from e
 
@@ -180,6 +186,7 @@ class AggregatorApi:
             aggregator_auth_token_hash=agg_hash,
             collector_auth_token_hash=col_hash,
             hpke_keys=(keypair,),
+            dp_config=dp_config,
         )
         try:
             self.datastore.run_tx(

@@ -279,6 +279,27 @@ def run_aggregation_job_driver(cfg, ds, stop) -> None:
         cfg, ds, stop)
 
 
+def default_dp_strategy():
+    """Driver-wide DP fallback from JANUS_DP_DEFAULT (JSON DpParams,
+    e.g. '{"mechanism": "discrete_gaussian", "epsilon_num": 1,
+    "delta_exp": 30}').  Tasks with a per-task dp_config always win;
+    this covers fleets that want a floor for legacy tasks.  Related
+    knobs: JANUS_DP_HOST_ONLY forces the host oracle path,
+    JANUS_DP_MAX_TABLE caps sampler table size."""
+    spec = os.environ.get("JANUS_DP_DEFAULT")
+    if not spec:
+        return None
+    import json
+
+    from janus_tpu.core.dp import strategy_for
+    from janus_tpu.dp.config import DpParams
+
+    try:
+        return strategy_for(DpParams.from_json_obj(json.loads(spec)))
+    except ValueError as e:
+        raise SystemExit(f"bad JANUS_DP_DEFAULT: {e}") from e
+
+
 def run_collection_job_driver(cfg, ds, stop) -> None:
     from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
 
@@ -287,7 +308,8 @@ def run_collection_job_driver(cfg, ds, stop) -> None:
             d,
             maximum_attempts_before_failure=(
                 c.job_driver.maximum_attempts_before_failure),
-            lease_duration_s=c.job_driver.worker_lease_duration_s),
+            lease_duration_s=c.job_driver.worker_lease_duration_s,
+            dp_strategy=default_dp_strategy()),
         cfg, ds, stop)
 
 

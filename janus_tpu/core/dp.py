@@ -1,29 +1,107 @@
 """Differential-privacy strategy seam (reference core/src/dp.rs:38 and
 collection_job_driver.rs:325).
 
-The reference delegates noise generation to prio's DifferentialPrivacyStrategy;
-`NoDifferentialPrivacy` is the production default.  Custom strategies
-implement `add_noise_to_agg_share(vdaf, agg_share, num_measurements)` and
-return a (possibly noised) share in the same representation.
+The reference delegates noise generation to prio's
+DifferentialPrivacyStrategy; ``NoDifferentialPrivacy`` is the production
+default.  Real mechanisms live in ``janus_tpu.dp.strategies`` (discrete
+Gaussian / discrete Laplace over the VDAF field, device kernel + exact
+host oracle) and register themselves here by mechanism name;
+``strategy_for`` resolves a task's persisted :class:`DpParams` to a
+strategy instance on the collection path.
+
+This module sits in the full ``mypy --strict`` tier: the seam is typed
+with structural protocols rather than ``Any`` so that a strategy that
+mis-handles the share representation fails the type gate, not a
+collection job.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import functools
+import threading
+from typing import TYPE_CHECKING, Callable, Protocol, runtime_checkable
+
+if TYPE_CHECKING:
+    from janus_tpu.dp.config import DpParams
+
+#: An aggregate share in decoded form: one Python int per field element.
+AggShare = list[int]
+
+
+class FieldSpec(Protocol):
+    """The slice of a VDAF field class the DP layer relies on."""
+
+    MODULUS: int
+    ENCODED_SIZE: int
+
+
+class DpVdaf(Protocol):
+    """The slice of a bound VDAF a DP strategy touches: just its field."""
+
+    @property
+    def field(self) -> FieldSpec: ...
+
+
+@runtime_checkable
+class DpStrategy(Protocol):
+    """A noise mechanism applied to one aggregate share.
+
+    Implementations must return a share in the same representation
+    (list of field ints, same length) — the caller re-encodes it with
+    the VDAF's own codec.
+    """
+
+    def add_noise_to_agg_share(self, vdaf: DpVdaf, agg_share: AggShare,
+                               num_measurements: int) -> AggShare: ...
 
 
 class NoDifferentialPrivacy:
     """Pass-through strategy (reference dp.rs:38)."""
 
-    def add_noise_to_agg_share(self, vdaf: Any, agg_share: Any,
-                               num_measurements: int) -> Any:
+    def add_noise_to_agg_share(self, vdaf: DpVdaf, agg_share: AggShare,
+                               num_measurements: int) -> AggShare:
         return agg_share
 
 
-class DpStrategy:
-    """Base for custom strategies; kept minimal so field-arithmetic noise
-    mechanisms (discrete Gaussian / Laplace over the VDAF field) can plug in."""
+NO_DP = NoDifferentialPrivacy()
 
-    def add_noise_to_agg_share(self, vdaf: Any, agg_share: Any,
-                               num_measurements: int) -> Any:
-        raise NotImplementedError
+StrategyFactory = Callable[["DpParams"], DpStrategy]
+
+_STRATEGIES: dict[str, StrategyFactory] = {}
+_REGISTER_LOCK = threading.Lock()
+
+
+def register_strategy(mechanism: str, factory: StrategyFactory) -> None:
+    """Register a mechanism-name -> strategy factory (idempotent)."""
+    with _REGISTER_LOCK:
+        _STRATEGIES[mechanism] = factory
+
+
+def _ensure_registered() -> None:
+    # The concrete strategies register themselves on import; importing
+    # lazily keeps core/ free of a hard jax dependency at import time.
+    import janus_tpu.dp.strategies  # noqa: F401
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_strategy(params: "DpParams") -> DpStrategy:
+    factory = _STRATEGIES.get(params.mechanism)
+    if factory is None:
+        raise ValueError(f"no DP strategy registered for mechanism "
+                         f"{params.mechanism!r}")
+    return factory(params)
+
+
+def strategy_for(params: "DpParams | None",
+                 default: DpStrategy | None = None) -> DpStrategy:
+    """Resolve a task's DP params to a strategy.
+
+    ``None`` params (no per-task DP config) resolve to ``default`` —
+    the process-wide strategy a binary was started with — or the
+    pass-through.  Instances are cached per params so device-kernel
+    caches and host-demotion state persist across collection steps.
+    """
+    if params is None:
+        return default if default is not None else NO_DP
+    _ensure_registered()
+    return _cached_strategy(params)

@@ -399,8 +399,8 @@ class Transaction:
                     task_expiration, report_expiry_age, min_batch_size,
                     time_precision, tolerable_clock_skew, collector_hpke_config,
                     aggregator_auth_token, collector_auth_token, taskprov,
-                    created_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                    dp_config, created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
                 (
                     tid, int(task.role), task.peer_aggregator_endpoint,
                     json.dumps(task.query_type.to_json_obj()),
@@ -411,7 +411,10 @@ class Transaction:
                     task.tolerable_clock_skew.seconds,
                     task.collector_hpke_config.encode()
                     if task.collector_hpke_config else None,
-                    agg_tok, col_tok, 1 if task.taskprov else 0, self._now(),
+                    agg_tok, col_tok, 1 if task.taskprov else 0,
+                    json.dumps(task.dp_config.to_json_obj())
+                    if task.dp_config is not None else None,
+                    self._now(),
                 ),
             )
         except sqlite3.IntegrityError as e:
@@ -432,7 +435,7 @@ class Transaction:
                       vdaf_verify_key, task_expiration, report_expiry_age,
                       min_batch_size, time_precision, tolerable_clock_skew,
                       collector_hpke_config, aggregator_auth_token,
-                      collector_auth_token, taskprov
+                      collector_auth_token, taskprov, dp_config
                FROM tasks WHERE task_id = ?""",
             (tid,),
         ).fetchone()
@@ -446,7 +449,7 @@ class Transaction:
                       vdaf, vdaf_verify_key, task_expiration, report_expiry_age,
                       min_batch_size, time_precision, tolerable_clock_skew,
                       collector_hpke_config, aggregator_auth_token,
-                      collector_auth_token, taskprov
+                      collector_auth_token, taskprov, dp_config
                FROM tasks"""
         ).fetchall()
         return [self._task_from_row(TaskId(r[0]), r[1:]) for r in rows]
@@ -455,7 +458,11 @@ class Transaction:
         tid = bytes(task_id)
         (role, endpoint, qt_json, vdaf_json, vk_enc, expiry, expiry_age, min_bs,
          precision, skew, collector_cfg, agg_tok_enc, col_tok_enc,
-         taskprov) = row
+         taskprov, dp_json) = row
+        dp_config = None
+        if dp_json is not None:
+            from janus_tpu.dp.config import DpParams
+            dp_config = DpParams.from_json_obj(json.loads(dp_json))
         agg_token = agg_hash = col_hash = None
         if agg_tok_enc is not None:
             obj = json.loads(self.crypter.decrypt(
@@ -495,6 +502,7 @@ class Transaction:
             aggregator_auth_token_hash=agg_hash,
             collector_auth_token_hash=col_hash,
             hpke_keys=tuple(keys),
+            dp_config=dp_config,
         )
 
     def delete_task(self, task_id: TaskId) -> None:

@@ -12,7 +12,7 @@ backend applies the same statements with type spellings adjusted
 (BLOB->BYTEA, AUTOINCREMENT->GENERATED ... AS IDENTITY).
 """
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # Incremental migrations: version N -> statements that upgrade a (N-1)
 # datastore (the analog of the reference's sqlx migration files).  Applied by
@@ -20,6 +20,9 @@ SCHEMA_VERSION = 2
 MIGRATIONS: dict[int, list[str]] = {
     2: [
         "ALTER TABLE tasks ADD COLUMN taskprov INTEGER NOT NULL DEFAULT 0",
+    ],
+    3: [
+        "ALTER TABLE tasks ADD COLUMN dp_config TEXT",
     ],
 }
 
@@ -67,6 +70,7 @@ TABLES = [
         aggregator_auth_token BLOB,        -- encrypted JSON: token (leader) / hash (helper)
         collector_auth_token BLOB,         -- encrypted JSON: hash
         taskprov INTEGER NOT NULL DEFAULT 0,
+        dp_config TEXT,                    -- JSON DpParams, NULL = no DP
         created_at INTEGER NOT NULL
     )
     """,

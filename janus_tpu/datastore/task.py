@@ -24,6 +24,11 @@ from janus_tpu.messages import (
 )
 from janus_tpu.models import VdafInstance
 
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from janus_tpu.dp.config import DpParams
+
 
 @dataclass(frozen=True)
 class QueryTypeCfg:
@@ -90,6 +95,10 @@ class AggregatorTask:
     # In-band provisioned via draft-wang-ppm-dap-taskprov: reports must carry
     # the taskprov extension, and HPKE uses the global keys.
     taskprov: bool = False
+    # Per-task DP mechanism applied to aggregate shares on the collection
+    # path (janus_tpu.dp); None means the process-wide default (usually
+    # no noise).
+    dp_config: "DpParams | None" = None
 
     def __post_init__(self):
         if not self.role.is_aggregator():
@@ -143,6 +152,7 @@ class TaskBuilder:
         self.helper_hpke_keypair = HpkeKeypair.generate(2)
         self.leader_endpoint = "https://leader.example.com/"
         self.helper_endpoint = "https://helper.example.com/"
+        self.dp_params: "DpParams | None" = None
 
     def with_min_batch_size(self, n: int) -> "TaskBuilder":
         self.min_batch_size = n
@@ -158,6 +168,10 @@ class TaskBuilder:
 
     def with_report_expiry_age(self, d: Duration | None) -> "TaskBuilder":
         self.report_expiry_age = d
+        return self
+
+    def with_dp_config(self, params: "DpParams | None") -> "TaskBuilder":
+        self.dp_params = params
         return self
 
     def leader_view(self) -> AggregatorTask:
@@ -177,6 +191,7 @@ class TaskBuilder:
             aggregator_auth_token=self.aggregator_auth_token,
             collector_auth_token_hash=AuthenticationTokenHash.of(self.collector_auth_token),
             hpke_keys=(self.leader_hpke_keypair,),
+            dp_config=self.dp_params,
         )
 
     def helper_view(self) -> AggregatorTask:
@@ -195,4 +210,5 @@ class TaskBuilder:
             collector_hpke_config=self.collector_keypair.config,
             aggregator_auth_token_hash=AuthenticationTokenHash.of(self.aggregator_auth_token),
             hpke_keys=(self.helper_hpke_keypair,),
+            dp_config=self.dp_params,
         )

@@ -1,0 +1,15 @@
+"""Device-resident differential privacy for the collection path.
+
+Layout:
+
+- ``tables``     — deterministic quantized inverse-CDF noise tables
+- ``samplers``   — exact-integer host oracle over those tables
+- ``kernels``    — JAX device kernel, bit-identical to the oracle
+- ``config``     — per-task :class:`DpParams` + calibration + codecs
+- ``strategies`` — ``DpStrategy`` impls with device->host demotion,
+  self-registered into :mod:`janus_tpu.core.dp`
+
+See docs/DP.md for the mechanism/threat-model write-up.
+"""
+
+from janus_tpu.dp.config import DpParams  # noqa: F401

@@ -1,0 +1,142 @@
+"""Per-task DP configuration: parameters, calibration, and codecs.
+
+:class:`DpParams` is the storage/API-facing form of a DP mechanism
+config — what the datastore persists, the aggregator API accepts, and
+taskprov's ``DpMechanism`` wire codepoints 2/3 map onto.  Parameters are
+exact rationals (epsilon as num/den, delta as a power of two) so that
+calibration is deterministic across hosts: the (epsilon, delta) -> sigma
+computation runs in ``decimal`` and rounds sigma UP on a fixed 2^-20
+grid, which can only add noise relative to the real-valued target.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from decimal import Decimal, localcontext
+from typing import Any
+
+from janus_tpu.dp import tables
+from janus_tpu.messages.taskprov import DpConfig, DpMechanism
+
+MECH_DISCRETE_GAUSSIAN = "discrete_gaussian"
+MECH_DISCRETE_LAPLACE = "discrete_laplace"
+
+# sigma is rationalized on this grid; ceil rounding keeps it >= the
+# real-valued calibration target.
+SIGMA_DENOMINATOR = 1 << 20
+
+
+@dataclass(frozen=True)
+class DpParams:
+    """One task's DP mechanism and privacy parameters.
+
+    epsilon = epsilon_num / epsilon_den; delta = 2^-delta_exp (discrete
+    Gaussian only); ``sensitivity`` bounds the L1 contribution of one
+    report to the aggregate share (1 for Prio3Count/Histogram).
+    """
+
+    mechanism: str
+    epsilon_num: int
+    epsilon_den: int = 1
+    delta_exp: int | None = None
+    sensitivity: int = 1
+
+    def __post_init__(self) -> None:
+        if self.mechanism not in (MECH_DISCRETE_GAUSSIAN,
+                                  MECH_DISCRETE_LAPLACE):
+            raise ValueError(f"unknown DP mechanism {self.mechanism!r}")
+        if self.epsilon_num <= 0 or self.epsilon_den <= 0:
+            raise ValueError("epsilon must be positive")
+        if self.sensitivity <= 0:
+            raise ValueError("sensitivity must be positive")
+        if self.mechanism == MECH_DISCRETE_GAUSSIAN:
+            if self.delta_exp is None or self.delta_exp <= 0:
+                raise ValueError("discrete_gaussian needs delta_exp >= 1")
+        elif self.delta_exp is not None:
+            raise ValueError("delta_exp only applies to discrete_gaussian")
+
+    # -- calibration --------------------------------------------------
+
+    def sigma(self) -> tuple[int, int]:
+        """(num, den) with num/den >= sqrt(2 ln(1.25/delta)) * sens/eps,
+        the classic analytic-Gaussian bound for (eps, delta)-DP."""
+        assert self.delta_exp is not None
+        with localcontext() as ctx:
+            ctx.prec = 50
+            ln_term = (Decimal("1.25") * Decimal(2) ** self.delta_exp).ln()
+            target = ((2 * ln_term).sqrt() * self.sensitivity
+                      * self.epsilon_den / self.epsilon_num)
+            num = int((target * SIGMA_DENOMINATOR).to_integral_value(
+                rounding="ROUND_CEILING"))
+        return max(1, num), SIGMA_DENOMINATOR
+
+    def scale(self) -> tuple[int, int]:
+        """Laplace scale s = sensitivity / epsilon, exactly rational."""
+        return self.sensitivity * self.epsilon_den, self.epsilon_num
+
+    def table(self) -> tables.NoiseTable:
+        if self.mechanism == MECH_DISCRETE_GAUSSIAN:
+            return tables.gaussian_table(*self.sigma())
+        return tables.laplace_table(*self.scale())
+
+    # -- codecs -------------------------------------------------------
+
+    def to_json_obj(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "mechanism": self.mechanism,
+            "epsilon_num": self.epsilon_num,
+            "epsilon_den": self.epsilon_den,
+            "sensitivity": self.sensitivity,
+        }
+        if self.delta_exp is not None:
+            out["delta_exp"] = self.delta_exp
+        return out
+
+    @classmethod
+    def from_json_obj(cls, obj: Any) -> "DpParams":
+        if not isinstance(obj, dict):
+            raise ValueError("dp_config must be a JSON object")
+        try:
+            return cls(mechanism=str(obj["mechanism"]),
+                       epsilon_num=int(obj["epsilon_num"]),
+                       epsilon_den=int(obj.get("epsilon_den", 1)),
+                       delta_exp=(int(obj["delta_exp"])
+                                  if obj.get("delta_exp") is not None
+                                  else None),
+                       sensitivity=int(obj.get("sensitivity", 1)))
+        except (KeyError, TypeError) as e:
+            raise ValueError(f"bad dp_config: {e!r}") from e
+
+    def to_dp_config(self) -> DpConfig:
+        """-> the taskprov wire form (DpMechanism codepoint 2 or 3)."""
+        if self.mechanism == MECH_DISCRETE_GAUSSIAN:
+            assert self.delta_exp is not None
+            return DpConfig(DpMechanism.discrete_gaussian(
+                self.epsilon_num, self.epsilon_den, self.delta_exp,
+                self.sensitivity))
+        return DpConfig(DpMechanism.discrete_laplace(
+            self.epsilon_num, self.epsilon_den, self.sensitivity))
+
+    @classmethod
+    def from_dp_mechanism(cls, mech: DpMechanism) -> "DpParams | None":
+        """taskprov wire form -> params; None for the NONE mechanism.
+
+        Raises ValueError for unrecognized codepoints or degenerate
+        parameters — taskprov opt-in converts that to InvalidTask.
+        """
+        if mech.is_none:
+            return None
+        if mech.codepoint == DpMechanism.DISCRETE_LAPLACE:
+            return cls(MECH_DISCRETE_LAPLACE,
+                       epsilon_num=int(mech.epsilon_num or 0),
+                       epsilon_den=int(mech.epsilon_den or 1),
+                       sensitivity=int(mech.sensitivity or 1))
+        if mech.codepoint == DpMechanism.DISCRETE_GAUSSIAN:
+            return cls(MECH_DISCRETE_GAUSSIAN,
+                       epsilon_num=int(mech.epsilon_num or 0),
+                       epsilon_den=int(mech.epsilon_den or 1),
+                       delta_exp=(int(mech.delta_exp)
+                                  if mech.delta_exp is not None else None),
+                       sensitivity=int(mech.sensitivity or 1))
+        raise ValueError(f"unsupported DP mechanism codepoint "
+                         f"{mech.codepoint}")

@@ -1,0 +1,81 @@
+"""Device kernel for DP noise addition on whole aggregate-share tensors.
+
+One sponge run squeezes the same little-endian 64-bit uniform stream the
+host oracle (janus_tpu.dp.samplers) reads, one word per share element;
+inverse-CDF sampling is then a vectorized threshold count against the
+precompiled :class:`NoiseTable`, and the sampled value is gathered from a
+``pack()``-ed noise-value table so the field add runs in whatever limb
+form the field module uses on device (raw for Field64, Montgomery for
+Field128) without any per-field casing here.  Every step is a fixed-shape
+map over the share vector — no data-dependent control flow — so the
+output is bit-identical to the oracle by construction, not statistically.
+
+The fresh noise seed is passed to the jitted function as a DYNAMIC uint8
+array: baking it into the absorbed message as static bytes would retrace
+the kernel on every collection.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.dp.samplers import DST_DP_NOISE
+from janus_tpu.dp.tables import NoiseTable
+from janus_tpu.ops import field64, field128, keccak, xof_batch
+
+_FIELD_OPS = {8: field64, 16: field128}
+
+
+def supported_encoded_sizes() -> tuple[int, ...]:
+    return tuple(sorted(_FIELD_OPS))
+
+
+@functools.lru_cache(maxsize=32)
+def _noise_fn(table: NoiseTable, encoded_size: int, n: int,
+              dst: bytes) -> Any:
+    ops = _FIELD_OPS[encoded_size]
+    thr = np.asarray(table.thresholds, dtype=np.uint64)
+    t_lo = jnp.asarray((thr & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    t_hi = jnp.asarray((thr >> np.uint64(32)).astype(np.uint32))
+    # Gather table: entry k holds (k - tail) mod p in the field module's
+    # device limb form (pack() handles the Montgomery conversion for
+    # Field128), so adding it to a packed share is a plain field add.
+    vals = [(k - table.tail) % ops.MODULUS
+            for k in range(len(table.thresholds) + 1)]
+    noise_limbs = jnp.asarray(ops.pack(vals))  # (LIMBS, 2*tail+1)
+    prefix = xof_batch.xof_prefix(dst)
+
+    def fn(share_limbs: Any, seed_u8: Any) -> Any:
+        blocks = xof_batch.build_blocks((), [prefix, seed_u8])
+        lo, hi = keccak.absorb_squeeze(blocks, n)  # each (n,) uint32
+        # u >= threshold, 64-bit lexicographic on the (hi, lo) pairs
+        ge = (hi[None, :] > t_hi[:, None]) | (
+            (hi[None, :] == t_hi[:, None]) & (lo[None, :] >= t_lo[:, None]))
+        k = jnp.sum(ge.astype(jnp.int32), axis=0)  # (n,) in [0, 2*tail]
+        return ops.add(share_limbs, jnp.take(noise_limbs, k, axis=1))
+
+    return jax.jit(fn)
+
+
+def add_noise_device(encoded_size: int, agg_share: list[int],
+                     table: NoiseTable, seed: bytes,
+                     dst: bytes = DST_DP_NOISE) -> list[int]:
+    """Noise ``agg_share`` (list of field ints) on device; returns ints.
+
+    Raises KeyError for fields without device ops and lets backend
+    errors propagate — the strategy layer classifies those and demotes
+    to the host oracle.
+    """
+    ops = _FIELD_OPS[encoded_size]
+    if len(seed) != 16:
+        raise ValueError("noise seed must be 16 bytes")
+    fn = _noise_fn(table, encoded_size, len(agg_share), dst)
+    packed = jnp.asarray(ops.pack(agg_share))
+    seed_u8 = jnp.asarray(np.frombuffer(seed, dtype=np.uint8))
+    out = np.asarray(jax.device_get(fn(packed, seed_u8)))
+    return [int(v) for v in np.atleast_1d(ops.unpack(out))]

@@ -1,0 +1,49 @@
+"""Host oracle for the DP noise samplers: exact integer arithmetic only.
+
+This module is the reference the device kernel (janus_tpu.dp.kernels) is
+proven against, in the same device/oracle pattern ``engine/resilient.py``
+uses for the prepare path.  Both sides consume the SAME uniform stream —
+``XofTurboShake128(seed, dst)`` with an empty binder, read as
+little-endian 64-bit words — and the same :class:`NoiseTable`, so under a
+fixed seed the outputs are bit-identical, not merely distributed alike.
+
+Noise seeds are SECRET: a collector that learns the seed can regenerate
+and subtract the noise, undoing the differential-privacy guarantee.
+janus-lint's secret-leak taint pass treats them accordingly.
+"""
+
+from __future__ import annotations
+
+from janus_tpu.dp.tables import NoiseTable
+from janus_tpu.vdaf.xof import XofTurboShake128
+
+# Domain-separation tag for the DP noise uniform stream.  Versioned: a
+# change to the sampling scheme must bump it so old seeds cannot be
+# replayed against a new interpretation.
+DST_DP_NOISE = b"janus_tpu dp noise v1"
+
+
+def uniform_stream_host(seed: bytes, n: int,
+                        dst: bytes = DST_DP_NOISE) -> list[int]:
+    """First ``n`` little-endian 64-bit words of the noise XOF stream."""
+    xof = XofTurboShake128(seed, dst)
+    xof.update(b"")
+    return [int.from_bytes(xof.next(8), "little") for _ in range(n)]
+
+
+def sample_host(table: NoiseTable, seed: bytes, n: int,
+                dst: bytes = DST_DP_NOISE) -> list[int]:
+    """``n`` signed noise values from the table under ``seed``."""
+    return [table.sample(u) for u in uniform_stream_host(seed, n, dst)]
+
+
+def add_noise_host(modulus: int, agg_share: list[int], table: NoiseTable,
+                   seed: bytes, dst: bytes = DST_DP_NOISE) -> list[int]:
+    """Add one noise draw per element, reduced mod the field modulus.
+
+    Negative noise wraps to ``modulus - |v|`` — exactly what a field
+    subtraction produces, so unsharding still yields plaintext-sum plus
+    (signed) noise.
+    """
+    noise = sample_host(table, seed, len(agg_share), dst)
+    return [(x + v) % modulus for x, v in zip(agg_share, noise)]

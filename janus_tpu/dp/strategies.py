@@ -1,0 +1,138 @@
+"""Concrete DpStrategy implementations: discrete Gaussian and Laplace.
+
+Each strategy owns a precompiled :class:`NoiseTable` (calibrated from the
+task's :class:`DpParams`) and noises aggregate shares on the collection
+path: the device kernel (janus_tpu.dp.kernels) by default, demoting to
+the exact host oracle (janus_tpu.dp.samplers) under the same semantics
+``ResilientEngine`` applies to the prepare path — a failure classified
+by ``is_backend_error`` (or active injected backend loss) trips a
+breaker that serves the host oracle for a backoff window before the
+device path is retried.  Both paths are bit-identical under the same
+seed, so demotion changes latency, never bytes.
+
+A FRESH random seed is drawn per noise application; reusing a seed
+across the leader and helper shares of one batch would make the noises
+cancel in the unsharded sum.  Noise seeds are secret (janus-lint
+secret-leak sources): anyone holding the seed can regenerate and
+subtract the noise.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import time
+
+from janus_tpu import metrics, profiler
+from janus_tpu.core.dp import AggShare, DpVdaf, register_strategy
+from janus_tpu.dp import samplers
+from janus_tpu.dp.config import (MECH_DISCRETE_GAUSSIAN,
+                                 MECH_DISCRETE_LAPLACE, DpParams)
+from janus_tpu.dp.tables import NoiseTable
+from janus_tpu.engine.resilient import backend_loss_active, is_backend_error
+
+
+def fresh_noise_seed() -> bytes:
+    """A fresh 16-byte DP noise seed.  SECRET: leaking it lets the
+    collector subtract the noise (janus-lint treats it as a taint
+    source)."""
+    return secrets.token_bytes(16)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _host_only() -> bool:
+    return os.environ.get("JANUS_DP_HOST_ONLY", "0").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+class TableNoiseStrategy:
+    """Shared machinery: table-driven noise with device->host demotion.
+
+    ``fixed_seed`` pins the per-application seed — parity tests only;
+    production callers must leave it None so every share draws fresh
+    noise.
+    """
+
+    mechanism: str = ""
+
+    def __init__(self, table: NoiseTable,
+                 fixed_seed: bytes | None = None) -> None:
+        self.table = table
+        self.fixed_seed = fixed_seed
+        self._demoted_until = 0.0
+
+    def _device_allowed(self) -> bool:
+        if _host_only() or backend_loss_active():
+            return False
+        return time.monotonic() >= self._demoted_until
+
+    def add_noise_to_agg_share(self, vdaf: DpVdaf, agg_share: AggShare,
+                               num_measurements: int) -> AggShare:
+        field = vdaf.field
+        seed = self.fixed_seed if self.fixed_seed is not None \
+            else fresh_noise_seed()
+        t0 = time.perf_counter()
+        path = "host"
+        out: AggShare | None = None
+        if self._device_allowed():
+            try:
+                from janus_tpu.dp import kernels
+                out = kernels.add_noise_device(field.ENCODED_SIZE,
+                                               agg_share, self.table, seed)
+                path = "device"
+            except KeyError:
+                pass  # field without device ops: host oracle, no breaker
+            except Exception as e:  # noqa: BLE001 - classify then re-raise
+                if not is_backend_error(e):
+                    raise
+                self._demoted_until = (time.monotonic()
+                                       + _env_float("JANUS_DP_PROBE_S", 5.0))
+        if out is None:
+            out = samplers.add_noise_host(field.MODULUS, agg_share,
+                                          self.table, seed)
+        elapsed = time.perf_counter() - t0
+        metrics.dp_noise_seconds.observe(elapsed, mechanism=self.mechanism,
+                                         path=path)
+        metrics.dp_noised_shares_total.add(1.0, mechanism=self.mechanism,
+                                           path=path)
+        profiler.record_batch(kind="dp_noise",
+                              vdaf=type(vdaf).__name__,
+                              bucket=len(agg_share),
+                              reports=num_measurements,
+                              decode_s=0.0, device_s=elapsed, encode_s=0.0,
+                              device=(path == "device"))
+        return out
+
+
+class DiscreteGaussianStrategy(TableNoiseStrategy):
+    """(epsilon, delta)-DP via the truncated, quantized discrete Gaussian
+    (Canonne-Kamath-Steinke 2020 mechanism, table-compiled)."""
+
+    mechanism = MECH_DISCRETE_GAUSSIAN
+
+    def __init__(self, params: DpParams,
+                 fixed_seed: bytes | None = None) -> None:
+        super().__init__(params.table(), fixed_seed)
+        self.params = params
+
+
+class DiscreteLaplaceStrategy(TableNoiseStrategy):
+    """epsilon-DP via the truncated, quantized discrete Laplace
+    (two-sided geometric) mechanism."""
+
+    mechanism = MECH_DISCRETE_LAPLACE
+
+    def __init__(self, params: DpParams,
+                 fixed_seed: bytes | None = None) -> None:
+        super().__init__(params.table(), fixed_seed)
+        self.params = params
+
+
+register_strategy(MECH_DISCRETE_GAUSSIAN, DiscreteGaussianStrategy)
+register_strategy(MECH_DISCRETE_LAPLACE, DiscreteLaplaceStrategy)

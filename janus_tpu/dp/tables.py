@@ -1,0 +1,150 @@
+"""Deterministic inverse-CDF tables for discrete noise distributions.
+
+The DP samplers (janus_tpu.dp.samplers / janus_tpu.dp.kernels) do not run
+the Canonne-Kamath-Steinke rejection loop on device: data-dependent loops
+are hostile to a fixed-shape XLA program.  Instead each mechanism is
+compiled AHEAD OF TIME into a quantized inverse-CDF table over a bounded
+support [-tail, +tail], and sampling becomes one 64-bit uniform draw plus
+a vectorized threshold count — the same work per element on host and
+device, which is what makes bit-exact parity provable rather than
+statistical.
+
+Table construction uses ``decimal`` exclusively: ``Decimal.exp`` is
+correctly rounded by the language spec, so the table bytes are identical
+on every platform and Python build — unlike ``math.exp``, whose libm
+varies.  The quantization grid is 2^64 (one threshold unit per possible
+uniform draw); with 80 digits of working precision the construction error
+is ~1e-61 of a grid cell, far below one unit in the last place.
+
+The distribution actually sampled is therefore the *quantized, truncated*
+discrete Gaussian / Laplace.  Truncation mass is < 2^-100 (Gaussian at 12
+sigma) / < 2^-72 (Laplace at 50 scales), and the exact first two moments
+of the quantized distribution are computable from the table itself
+(``NoiseTable.mean`` / ``variance``), which is what the statistical tests
+assert against.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from bisect import bisect_right
+from dataclasses import dataclass
+from decimal import Decimal, localcontext
+from fractions import Fraction
+
+SCALE_BITS = 64
+SCALE = 1 << SCALE_BITS
+_PREC = 80  # decimal working digits; error << one 2^-64 grid cell
+
+# Support bounds.  P(|X| > 12 sigma) < 2*exp(-72) < 2^-102 for the
+# discrete Gaussian; P(|X| >= 50 s) ~ e^-50 < 2^-72 for discrete Laplace.
+GAUSSIAN_TAIL_SIGMAS = 12
+LAPLACE_TAIL_SCALES = 50
+
+
+def max_table_entries() -> int:
+    """Threshold-count ceiling (env knob ``JANUS_DP_MAX_TABLE``).
+
+    A table needs 2*tail thresholds; extreme sigmas (tiny epsilon) would
+    otherwise build multi-megabyte device constants.  Calibrations past
+    the cap raise ValueError at strategy-construction time instead of
+    stalling the collection path.
+    """
+    try:
+        return max(16, int(os.environ.get("JANUS_DP_MAX_TABLE",
+                                          str(1 << 16))))
+    except ValueError:
+        return 1 << 16
+
+
+@dataclass(frozen=True)
+class NoiseTable:
+    """Quantized inverse CDF of a symmetric integer noise distribution.
+
+    ``thresholds[i] = floor(CDF(i - tail) * 2^64)`` for ``i`` in
+    ``[0, 2*tail)``; the sampled value for a uniform 64-bit draw ``u`` is
+    ``#{i : thresholds[i] <= u} - tail``.  Thresholds are nondecreasing;
+    ``u < 2^64`` always, so values stay within ``[-tail, tail]``.
+    """
+
+    tail: int
+    thresholds: tuple[int, ...]
+
+    def sample(self, u: int) -> int:
+        """Exact host-side inversion of one uniform draw (Python ints)."""
+        return bisect_right(self.thresholds, u) - self.tail
+
+    def probabilities(self) -> list[Fraction]:
+        """Exact per-value probabilities of the quantized distribution,
+        index i = value (i - tail)."""
+        bounds = (0,) + self.thresholds + (SCALE,)
+        return [Fraction(bounds[i + 1] - bounds[i], SCALE)
+                for i in range(len(self.thresholds) + 1)]
+
+    def mean(self) -> Fraction:
+        return sum((Fraction(i - self.tail) * p
+                    for i, p in enumerate(self.probabilities())),
+                   Fraction(0))
+
+    def variance(self) -> Fraction:
+        mu = self.mean()
+        return sum(((Fraction(i - self.tail) - mu) ** 2 * p
+                    for i, p in enumerate(self.probabilities())),
+                   Fraction(0))
+
+
+def _quantize(weights: list[Decimal]) -> tuple[int, ...]:
+    """Cumulative weights -> floor(cdf * 2^64) thresholds, dropping the
+    final (== 2^64) entry."""
+    with localcontext() as ctx:
+        ctx.prec = _PREC
+        total = Decimal(0)
+        for w in weights:
+            total += w
+        out = []
+        cum = Decimal(0)
+        for w in weights[:-1]:
+            cum += w
+            out.append(int(cum * SCALE / total))
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=64)
+def gaussian_table(sigma_num: int, sigma_den: int) -> NoiseTable:
+    """Discrete Gaussian N_Z(0, sigma^2), sigma = sigma_num/sigma_den,
+    truncated at 12 sigma and quantized to the 2^-64 grid."""
+    if sigma_num <= 0 or sigma_den <= 0:
+        raise ValueError("sigma must be positive")
+    tail = max(1, -(-GAUSSIAN_TAIL_SIGMAS * sigma_num // sigma_den))
+    if 2 * tail > max_table_entries():
+        raise ValueError(
+            f"gaussian sigma {sigma_num}/{sigma_den} needs {2 * tail} "
+            f"table entries, over the JANUS_DP_MAX_TABLE cap "
+            f"{max_table_entries()}")
+    with localcontext() as ctx:
+        ctx.prec = _PREC
+        two_var = 2 * Decimal(sigma_num) ** 2
+        weights = [(-Decimal((k * sigma_den) ** 2) / two_var).exp()
+                   for k in range(-tail, tail + 1)]
+    return NoiseTable(tail, _quantize(weights))
+
+
+@functools.lru_cache(maxsize=64)
+def laplace_table(scale_num: int, scale_den: int) -> NoiseTable:
+    """Discrete Laplace (two-sided geometric) with scale s =
+    scale_num/scale_den: P(k) proportional to exp(-|k|/s), truncated at
+    50 s and quantized to the 2^-64 grid."""
+    if scale_num <= 0 or scale_den <= 0:
+        raise ValueError("scale must be positive")
+    tail = max(1, -(-LAPLACE_TAIL_SCALES * scale_num // scale_den))
+    if 2 * tail > max_table_entries():
+        raise ValueError(
+            f"laplace scale {scale_num}/{scale_den} needs {2 * tail} "
+            f"table entries, over the JANUS_DP_MAX_TABLE cap "
+            f"{max_table_entries()}")
+    with localcontext() as ctx:
+        ctx.prec = _PREC
+        weights = [(-Decimal(abs(k) * scale_den) / Decimal(scale_num)).exp()
+                   for k in range(-tail, tail + 1)]
+    return NoiseTable(tail, _quantize(weights))

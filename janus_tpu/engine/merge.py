@@ -1,0 +1,116 @@
+"""Device-batched merge of batch-aggregation shard accumulators.
+
+``merge_batch_aggregations`` (aggregator.py) historically decoded and
+field-added shard aggregate shares one at a time in Python — O(shards x
+share_len) bigint work on the host, sitting directly on the collection
+path the DP noise kernel now also runs on.  This module batches it: all
+shard blobs are decoded into one (LIMBS, n_shards, share_len) uint32
+tensor with numpy, range-checked vectorized, and tree-reduced modulo p
+on device in one jitted launch.
+
+Field addition mod p is associative and the limb kernels are exact, so
+the device reduction is bit-identical to the sequential Python fold.
+The caller keeps report-count / checksum / interval accumulation on the
+host (cheap scalar work) and falls back to the Python fold when the
+shapes do not qualify or the backend is lost mid-launch.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+from janus_tpu import profiler
+from janus_tpu.ops import field64, field128
+
+_FIELD_OPS = {8: field64, 16: field128}
+
+
+def _min_device_elems() -> int:
+    """Below this many field elements (shards x share length) the jit
+    dispatch overhead beats the bigint loop; env knob for tests/bench."""
+    try:
+        return int(os.environ.get("JANUS_MERGE_DEVICE_MIN_ELEMS", "512"))
+    except ValueError:
+        return 512
+
+
+@functools.lru_cache(maxsize=32)
+def _merge_fn(encoded_size: int, n_shards: int, length: int) -> Any:
+    import jax
+
+    ops = _FIELD_OPS[encoded_size]
+
+    def fn(x: Any) -> Any:  # x: (LIMBS, n_shards, length) raw uint32 limbs
+        # addition is representation-agnostic (raw residues < p stay
+        # raw residues), so no Montgomery round-trip is needed even for
+        # field128 — sum_mod is exact on the wire limbs directly
+        return ops.sum_mod(x, axis=0)
+
+    return jax.jit(fn)
+
+
+def merge_encoded_shares(vdaf: Any, blobs: list[bytes],
+                         force: bool = False) -> list[int] | None:
+    """Decode + field-sum encoded aggregate shares on device.
+
+    Returns the merged share as field ints, or None when the input does
+    not qualify for the device path (unsupported field, too small, or a
+    malformed blob length) — the caller then runs the Python fold.
+    Raises ValueError for out-of-range elements (mirroring
+    ``decode_vec``) and lets backend errors propagate for the caller to
+    classify.
+    """
+    field = getattr(vdaf, "field", None)
+    enc = getattr(field, "ENCODED_SIZE", None)
+    ops = _FIELD_OPS.get(enc)
+    if ops is None or len(blobs) < 2:
+        return None
+    nbytes = len(blobs[0])
+    if nbytes == 0 or nbytes % enc != 0:
+        return None
+    if any(len(b) != nbytes for b in blobs[1:]):
+        return None
+    length = nbytes // enc
+    if not force and len(blobs) * length < _min_device_elems():
+        return None
+
+    t0 = time.perf_counter()
+    limbs = enc // 4
+    # wire order is element-major little-endian; '<u4' views each element
+    # as `limbs` consecutive uint32 words
+    raw = np.frombuffer(b"".join(blobs), dtype="<u4").reshape(
+        len(blobs), length, limbs)
+    # vectorized range check (decode_vec parity): element >= p is a
+    # protocol violation, not a backend problem
+    p_limbs = [(field.MODULUS >> (32 * i)) & 0xFFFFFFFF
+               for i in range(limbs)]
+    eq = np.ones(raw.shape[:2], dtype=bool)
+    gt = np.zeros(raw.shape[:2], dtype=bool)
+    for i in range(limbs - 1, -1, -1):
+        gt |= eq & (raw[:, :, i] > p_limbs[i])
+        eq &= raw[:, :, i] == p_limbs[i]
+    if bool(np.any(gt | eq)):
+        raise ValueError("field element out of range")
+    t1 = time.perf_counter()
+
+    import jax
+    x = np.ascontiguousarray(np.transpose(raw, (2, 0, 1)))
+    out = np.asarray(jax.device_get(  # janus-lint: disable=hot-path-sync -- merged share must land on host to re-encode for the collector; single sync per merge
+        _merge_fn(enc, len(blobs), length)(x)))  # (limbs, length) raw
+    t2 = time.perf_counter()
+
+    acc = np.zeros(length, dtype=object)
+    for i in range(limbs):
+        acc += out[i].astype(object) << (32 * i)
+    merged = [int(v) for v in acc]
+    t3 = time.perf_counter()
+    profiler.record_batch(kind="agg_merge", vdaf=type(vdaf).__name__,
+                          bucket=length, reports=len(blobs),
+                          decode_s=t1 - t0, device_s=t2 - t1,
+                          encode_s=t3 - t2, device=True)
+    return merged

@@ -94,15 +94,38 @@ class QueryConfig(WireMessage):
 
 @dataclass(frozen=True)
 class DpMechanism(WireMessage):
-    """reference taskprov.rs:514."""
+    """reference taskprov.rs:514.
+
+    Codepoints 2 and 3 are the janus_tpu noise mechanisms (see
+    docs/DP.md).  Their parameters ride in the codepoint payload as
+    rationals so the wire form is exact: epsilon = epsilon_num /
+    epsilon_den, delta = 2^-delta_exp (discrete Gaussian only), and an
+    integer L1 ``sensitivity`` bound.  Unrecognized codepoints still
+    absorb the rest of the payload byte-for-byte, so foreign configs
+    survive a decode/encode roundtrip and taskprov task-id hashes are
+    preserved.
+    """
 
     RESERVED = 0
     NONE = 1
+    DISCRETE_LAPLACE = 2
+    DISCRETE_GAUSSIAN = 3
 
     codepoint: int
     payload: bytes = b""
+    epsilon_num: int | None = None
+    epsilon_den: int | None = None
+    delta_exp: int | None = None
+    sensitivity: int | None = None
 
     def encode(self) -> bytes:
+        if self.codepoint == self.DISCRETE_LAPLACE:
+            return (u8(self.codepoint) + u32(self.epsilon_num)
+                    + u32(self.epsilon_den) + u32(self.sensitivity))
+        if self.codepoint == self.DISCRETE_GAUSSIAN:
+            return (u8(self.codepoint) + u32(self.epsilon_num)
+                    + u32(self.epsilon_den) + u8(self.delta_exp)
+                    + u32(self.sensitivity))
         return u8(self.codepoint) + self.payload
 
     @classmethod
@@ -110,8 +133,36 @@ class DpMechanism(WireMessage):
         codepoint = cur.u8()
         if codepoint in (cls.RESERVED, cls.NONE):
             return cls(codepoint)
-        # Unrecognized mechanisms absorb the rest of the payload.
-        return cls(codepoint, cur.take(cur.remaining()))
+        if codepoint == cls.DISCRETE_LAPLACE:
+            mech = cls(codepoint, epsilon_num=cur.u32(),
+                       epsilon_den=cur.u32(), sensitivity=cur.u32())
+        elif codepoint == cls.DISCRETE_GAUSSIAN:
+            mech = cls(codepoint, epsilon_num=cur.u32(),
+                       epsilon_den=cur.u32(), delta_exp=cur.u8(),
+                       sensitivity=cur.u32())
+        else:
+            # Unrecognized mechanisms absorb the rest of the payload.
+            return cls(codepoint, cur.take(cur.remaining()))
+        if (mech.epsilon_num == 0 or mech.epsilon_den == 0
+                or mech.sensitivity == 0
+                or (codepoint == cls.DISCRETE_GAUSSIAN
+                    and mech.delta_exp == 0)):
+            raise DecodeError("degenerate DP mechanism parameters")
+        return mech
+
+    @classmethod
+    def discrete_laplace(cls, epsilon_num: int, epsilon_den: int = 1,
+                         sensitivity: int = 1) -> "DpMechanism":
+        return cls(cls.DISCRETE_LAPLACE, epsilon_num=epsilon_num,
+                   epsilon_den=epsilon_den, sensitivity=sensitivity)
+
+    @classmethod
+    def discrete_gaussian(cls, epsilon_num: int, epsilon_den: int,
+                          delta_exp: int,
+                          sensitivity: int = 1) -> "DpMechanism":
+        return cls(cls.DISCRETE_GAUSSIAN, epsilon_num=epsilon_num,
+                   epsilon_den=epsilon_den, delta_exp=delta_exp,
+                   sensitivity=sensitivity)
 
     @property
     def is_none(self) -> bool:
@@ -119,7 +170,9 @@ class DpMechanism(WireMessage):
 
     @property
     def is_recognized(self) -> bool:
-        return self.codepoint in (self.RESERVED, self.NONE)
+        return self.codepoint in (self.RESERVED, self.NONE,
+                                  self.DISCRETE_LAPLACE,
+                                  self.DISCRETE_GAUSSIAN)
 
 
 @dataclass(frozen=True)

@@ -363,6 +363,16 @@ prepare_transfer_seconds = REGISTRY.histogram(
     "janus_prepare_transfer_seconds",
     "host<->device transfer time per prepare launch (upload of inputs + "
     "fetch of host-bound outputs), by engine kind")
+# differential-privacy noise instruments (janus_tpu/dp/strategies.py):
+# noise added to aggregate shares on the collection path, labelled by
+# mechanism (discrete_gaussian/discrete_laplace) and execution path
+# (device kernel vs exact host oracle)
+dp_noise_seconds = REGISTRY.histogram(
+    "janus_dp_noise_seconds",
+    "DP noise-add latency per aggregate share, by mechanism and path")
+dp_noised_shares_total = REGISTRY.counter(
+    "janus_dp_noised_shares_total",
+    "aggregate shares noised on the collection path, by mechanism and path")
 
 
 def all_instruments() -> list:

@@ -56,6 +56,15 @@ def cmd_create_datastore_key(args) -> int:
     return 0
 
 
+def _parse_dp_config(obj):
+    """JSON/YAML DpParams object -> DpParams, None passes through."""
+    if obj is None:
+        return None
+    from janus_tpu.dp.config import DpParams
+
+    return DpParams.from_json_obj(obj)
+
+
 def cmd_provision_tasks(args) -> int:
     """Load tasks from YAML into the datastore (reference janus_cli.rs:160)."""
     from janus_tpu.core.auth_tokens import (
@@ -113,6 +122,7 @@ def cmd_provision_tasks(args) -> int:
             aggregator_auth_token_hash=agg_hash,
             collector_auth_token_hash=col_hash,
             hpke_keys=tuple(hpke_keys),
+            dp_config=_parse_dp_config(doc.get("dp_config")),
         )
         try:
             ds.run_tx("provision", lambda tx: tx.put_aggregator_task(task))

@@ -53,25 +53,36 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 # -- the mixed-VDAF task matrix --------------------------------------------
 # name -> (VdafInstance factory, provision-tasks JSON shape, measurement
-# sampler).  Small parameterizations: the soak measures pipeline + ledger
-# behavior under sustained load, not kernel throughput.
+# sampler, DpParams-or-None).  Small parameterizations: the soak measures
+# pipeline + ledger behavior under sustained load, not kernel throughput.
+# A dp entry noises both aggregators' shares on the collection path; the
+# funnel conservation audit is untouched by it because the audit compares
+# PRE-NOISE report counts (exact in share-space), never decoded sums.
 
 def _vdaf_matrix():
+    from janus_tpu.dp.config import DpParams
     from janus_tpu.models import VdafInstance
 
     return {
         "count": (lambda: VdafInstance.prio3_count(), "Prio3Count",
-                  lambda rng: rng.randint(0, 1)),
+                  lambda rng: rng.randint(0, 1), None),
         "sum": (lambda: VdafInstance.prio3_sum(8),
                 {"Prio3Sum": {"bits": 8}},
-                lambda rng: rng.randint(0, 255)),
+                lambda rng: rng.randint(0, 255), None),
         "sumvec": (lambda: VdafInstance.prio3_sum_vec(1, 8, 3),
                    {"Prio3SumVec": {"bits": 1, "length": 8,
                                     "chunk_length": 3}},
-                   lambda rng: [rng.randint(0, 1) for _ in range(8)]),
+                   lambda rng: [rng.randint(0, 1) for _ in range(8)], None),
         "histogram": (lambda: VdafInstance.prio3_histogram(4, 2),
                       {"Prio3Histogram": {"length": 4, "chunk_length": 2}},
-                      lambda rng: rng.randrange(4)),
+                      lambda rng: rng.randrange(4), None),
+        # DP'd histogram (ISSUE 13 tentpole d): discrete-Gaussian noise on
+        # every collected aggregate share, eps=1, delta=2^-30
+        "histogram_dp": (lambda: VdafInstance.prio3_histogram(8, 3),
+                         {"Prio3Histogram": {"length": 8, "chunk_length": 3}},
+                         lambda rng: rng.randrange(8),
+                         DpParams("discrete_gaussian", epsilon_num=1,
+                                  epsilon_den=1, delta_exp=30)),
     }
 
 
@@ -197,10 +208,12 @@ class InProcessTopology:
         self.leader_http = DapHttpServer(self.leader_agg).start()
 
         self.builders = []
-        for vdaf_name, (factory, _json_shape, _measure) in task_defs:
+        for vdaf_name, (factory, _json_shape, _measure, dp) in task_defs:
             b = TaskBuilder(QueryTypeCfg.time_interval(), factory())
             b.with_min_batch_size(1)
             b.with_report_expiry_age(Duration(7200))
+            if dp is not None:
+                b.with_dp_config(dp)
             b.leader_endpoint = self.leader_http.address
             b.helper_endpoint = self.helper_http.address
             self.helper_ds.run_tx(
@@ -299,9 +312,10 @@ class ComposeTopology:
                                      min_aggregation_job_size=min_job,
                                      max_aggregation_job_size=max_job)
         specs = []
-        for vdaf_name, (_factory, json_shape, _measure) in task_defs:
-            specs.append(TaskSpec(vdaf=json_shape, min_batch_size=1,
-                                  report_expiry_age_s=7200))
+        for vdaf_name, (_factory, json_shape, _measure, dp) in task_defs:
+            specs.append(TaskSpec(
+                vdaf=json_shape, min_batch_size=1, report_expiry_age_s=7200,
+                dp_config=dp.to_json_obj() if dp is not None else None))
         self.topo.provision(specs)
         self.topo.start()
         self.builders = list(zip([n for n, _ in task_defs], specs))
@@ -337,7 +351,7 @@ def build_workloads(args, topo, task_defs):
     from janus_tpu.messages import Duration, TaskId
 
     workloads = []
-    for i, ((vdaf_name, (factory, _shape, measure)),
+    for i, ((vdaf_name, (factory, _shape, measure, _dp)),
             (name2, builder_or_spec)) in enumerate(
                 zip(task_defs, topo.builders)):
         if args.mode == "inprocess":
@@ -388,7 +402,7 @@ def warm_engines(task_defs, job_size: int, log) -> None:
 
     n = bucket_size(max(1, job_size))
     jobs, seen = [], set()
-    for vdaf_name, (factory, _shape, measure) in task_defs:
+    for vdaf_name, (factory, _shape, measure, _dp) in task_defs:
         if vdaf_name not in seen:
             seen.add(vdaf_name)
             jobs.append((vdaf_name, factory(), measure))
@@ -484,7 +498,7 @@ def run_collections(args, topo, task_defs, run_start_s: float,
     from janus_tpu.messages import Duration, Interval, Query, TaskId, Time
 
     results = []
-    for (vdaf_name, (factory, _shape, _measure)), (name2, b) in zip(
+    for (vdaf_name, (factory, _shape, _measure, dp)), (name2, b) in zip(
             task_defs, topo.builders):
         if args.mode == "inprocess":
             task_id, precision = b.task_id, b.time_precision.seconds
@@ -496,7 +510,13 @@ def run_collections(args, topo, task_defs, run_start_s: float,
         end -= end % precision
         query = Query.time_interval(Interval(Time(start),
                                              Duration(end - start)))
-        entry = {"task": f"{vdaf_name}", "ok": False, "report_count": 0}
+        # DP'd tasks are still EXACT in share-space for audit purposes:
+        # noise is added to the aggregate share after count/checksum
+        # validation, so report_count (and the funnel conservation audit,
+        # which compares pre-noise funnel counts) is unaffected — only
+        # the decoded sum carries noise.
+        entry = {"task": f"{vdaf_name}", "ok": False, "report_count": 0,
+                 "dp": dp.mechanism if dp is not None else None}
         try:
             collector = Collector(task_id, topo.leader_url, token, keypair,
                                   factory())

@@ -399,7 +399,7 @@ def test_concurrent_lease_acquisition(ds, task_pair):
     assert len(ids) == 8 and len(set(ids)) == 8
 
 
-def test_schema_migration_v1_to_v2(tmp_path):
+def test_schema_migration_v1_to_current(tmp_path):
     """A v1 on-disk datastore upgrades in place via Datastore.migrate()."""
     import sqlite3
 
@@ -408,12 +408,13 @@ def test_schema_migration_v1_to_v2(tmp_path):
     from janus_tpu.datastore.schema import MIGRATIONS, SCHEMA_VERSION, TABLES
 
     path = str(tmp_path / "v1.db")
-    # Build a v1 database: current DDL minus the v2 migration's column.
+    # Build a v1 database: current DDL minus every later migration's column.
     conn = sqlite3.connect(path)
     with conn:
         for ddl in TABLES:
             ddl_v1 = ddl.replace(
-                "taskprov INTEGER NOT NULL DEFAULT 0,\n", "")
+                "taskprov INTEGER NOT NULL DEFAULT 0,\n", "").replace(
+                "dp_config TEXT,                    -- JSON DpParams, NULL = no DP\n", "")
             conn.execute(ddl_v1)
         conn.execute("INSERT INTO schema_version (version) VALUES (1)")
     conn.close()
@@ -426,11 +427,61 @@ def test_schema_migration_v1_to_v2(tmp_path):
         pass
     ds.migrate()
     ds.check_schema_version()
-    # the migrated column exists and defaults to 0
+    # the migrated columns exist with their defaults
     conn = sqlite3.connect(path)
     assert conn.execute("SELECT COUNT(*) FROM tasks WHERE taskprov = 0").fetchone()[0] == 0
+    assert conn.execute("SELECT COUNT(*) FROM tasks WHERE dp_config IS NULL").fetchone()[0] == 0
     conn.close()
-    assert 2 in MIGRATIONS and SCHEMA_VERSION == 2
+    assert 2 in MIGRATIONS and 3 in MIGRATIONS and SCHEMA_VERSION == 3
+
+
+def test_schema_migration_v2_to_v3_preserves_tasks(tmp_path):
+    """A v2 datastore (taskprov, no dp_config) migrates and re-serves its
+    tasks with dp_config=None."""
+    import sqlite3
+
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import Crypter, Datastore, SqliteBackend
+    from janus_tpu.datastore.schema import TABLES
+    from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+    from janus_tpu.models import VdafInstance
+
+    path = str(tmp_path / "v2.db")
+    conn = sqlite3.connect(path)
+    with conn:
+        for ddl in TABLES:
+            conn.execute(ddl.replace(
+                "dp_config TEXT,                    -- JSON DpParams, NULL = no DP\n", ""))
+        conn.execute("INSERT INTO schema_version (version) VALUES (1)")
+        conn.execute("INSERT INTO schema_version (version) VALUES (2)")
+    conn.close()
+
+    crypter = Crypter.generate()
+    ds = Datastore(SqliteBackend(path), crypter, MockClock())
+    task = TaskBuilder(QueryTypeCfg.time_interval(),
+                       VdafInstance.prio3_count()).leader_view()
+    # v2 writer: insert without the dp_config column (pre-migration code)
+    conn = sqlite3.connect(path)
+    with conn:
+        conn.execute(
+            """INSERT INTO tasks (task_id, aggregator_role,
+                peer_aggregator_endpoint, query_type, vdaf, vdaf_verify_key,
+                min_batch_size, time_precision, tolerable_clock_skew,
+                taskprov, created_at)
+               VALUES (?,?,?,?,?,?,?,?,?,0,0)""",
+            (bytes(task.task_id), int(task.role),
+             task.peer_aggregator_endpoint, '"TimeInterval"',
+             '{"Prio3Count": {}}',
+             crypter.encrypt("tasks", bytes(task.task_id), "vdaf_verify_key",
+                             task.vdaf_verify_key),
+             task.min_batch_size, task.time_precision.seconds,
+             task.tolerable_clock_skew.seconds))
+    conn.close()
+
+    ds.migrate()
+    ds.check_schema_version()
+    got = ds.run_tx("get", lambda tx: tx.get_aggregator_task(task.task_id))
+    assert got is not None and got.dp_config is None
 
 
 # -- Postgres dialect translation (pure, no server needed) -----------------

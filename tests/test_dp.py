@@ -1,0 +1,441 @@
+"""Device-resident differential privacy (janus_tpu/dp/): sampler tables,
+fixed-seed device/host parity, strategy demotion, config plumbing from
+wire codec through datastore to the collection path, and the noised
+end-to-end collection."""
+
+import math
+import random
+from fractions import Fraction
+
+import pytest
+
+from janus_tpu.dp import samplers, tables
+from janus_tpu.dp.config import SIGMA_DENOMINATOR, DpParams
+from janus_tpu.vdaf.field_ref import Field64, Field128
+
+
+# -- table construction: exact moments --------------------------------------
+
+def test_gaussian_table_moments():
+    t = tables.gaussian_table(3, 1)
+    assert t.tail == 36  # 12 sigma
+    probs = t.probabilities()
+    assert sum(probs) == Fraction(1)
+    # symmetric construction: mean ~0, variance ~sigma^2 (the discrete
+    # Gaussian variance converges to sigma^2 double-exponentially)
+    assert abs(t.mean()) < 1e-9
+    assert t.variance() == pytest.approx(9.0, rel=1e-3)
+
+
+def test_laplace_table_moments():
+    t = tables.laplace_table(2, 1)
+    assert t.tail == 100  # 50 scales
+    # two-sided geometric with alpha = e^{-1/s}: var = 2a/(1-a)^2
+    a = math.exp(-0.5)
+    assert abs(t.mean()) < 1e-9
+    assert t.variance() == pytest.approx(2 * a / (1 - a) ** 2, rel=1e-6)
+
+
+def test_table_cap_enforced(monkeypatch):
+    monkeypatch.setenv("JANUS_DP_MAX_TABLE", "64")
+    with pytest.raises(ValueError):
+        tables.gaussian_table(1000, 1)
+
+
+# -- host sampler: statistical sanity against the exact table ---------------
+
+def test_host_sampler_statistics():
+    t = tables.gaussian_table(5, 1)
+    n = 100_000
+    draws = samplers.sample_host(t, b"\x07" * 16, n)
+    assert all(-t.tail <= v <= t.tail for v in draws)
+    mean = sum(draws) / n
+    var = sum((v - mean) ** 2 for v in draws) / n
+    sigma = math.sqrt(t.variance())
+    # mean of n draws has stddev sigma/sqrt(n); 5-sigma band
+    assert abs(mean - t.mean()) < 5 * sigma / math.sqrt(n)
+    assert var == pytest.approx(t.variance(), rel=0.05)
+
+
+def test_host_sampler_deterministic():
+    t = tables.laplace_table(2, 1)
+    a = samplers.sample_host(t, b"\x01" * 16, 64)
+    b = samplers.sample_host(t, b"\x01" * 16, 64)
+    c = samplers.sample_host(t, b"\x02" * 16, 64)
+    assert a == b
+    assert a != c
+
+
+def test_modular_wraparound():
+    """Negative draws land as modulus - |v|: exactly a field subtract."""
+    t = tables.gaussian_table(5, 1)
+    p = Field64.MODULUS
+    noised = samplers.add_noise_host(p, [0] * 1000, t, b"\x03" * 16)
+    assert all(v < p for v in noised)
+    assert all(v <= t.tail or v >= p - t.tail for v in noised)
+    # sigma=5 over 1000 elements: negative draws are statistically certain
+    assert any(v >= p - t.tail for v in noised)
+    assert any(0 < v <= t.tail for v in noised)
+
+
+# -- device kernel: bit-exact parity with the host oracle -------------------
+
+@pytest.mark.parametrize("field", [Field64, Field128])
+@pytest.mark.parametrize("make_table", [
+    lambda: tables.gaussian_table(5, 1),
+    lambda: tables.laplace_table(2, 1),
+])
+def test_device_host_parity_fixed_seed(field, make_table):
+    from janus_tpu.dp import kernels
+
+    t = make_table()
+    seed = b"\x2a" * 16
+    rng = random.Random(1234)
+    share = [rng.randrange(field.MODULUS) for _ in range(257)]
+    host = samplers.add_noise_host(field.MODULUS, share, t, seed)
+    dev = kernels.add_noise_device(field.ENCODED_SIZE, share, t, seed)
+    assert dev == host
+
+
+def test_device_kernel_rejects_unknown_field():
+    from janus_tpu.dp import kernels
+
+    t = tables.gaussian_table(2, 1)
+    assert 8 in kernels.supported_encoded_sizes()
+    assert 16 in kernels.supported_encoded_sizes()
+    with pytest.raises(KeyError):
+        kernels.add_noise_device(32, [0], t, b"\x00" * 16)
+
+
+# -- strategies: registry, demotion, fixed-seed determinism -----------------
+
+class _FakeVdaf:
+    def __init__(self, field):
+        self.field = field
+
+
+def test_strategy_registry_and_caching():
+    from janus_tpu.core.dp import NO_DP, strategy_for
+    from janus_tpu.dp.strategies import DiscreteGaussianStrategy
+
+    assert strategy_for(None) is NO_DP
+    params = DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+                      delta_exp=30)
+    s = strategy_for(params)
+    assert isinstance(s, DiscreteGaussianStrategy)
+    # cached: breaker state survives repeated lookups of the same params
+    assert strategy_for(params) is s
+
+
+def test_no_dp_is_identity():
+    from janus_tpu.core.dp import NO_DP
+
+    share = [1, 2, 3]
+    assert NO_DP.add_noise_to_agg_share(_FakeVdaf(Field64), share, 3) == share
+
+
+def test_strategy_host_only_matches_device(monkeypatch):
+    from janus_tpu.dp.strategies import DiscreteLaplaceStrategy
+
+    params = DpParams("discrete_laplace", epsilon_num=1, epsilon_den=2)
+    vdaf = _FakeVdaf(Field128)
+    share = [7] * 33
+    dev = DiscreteLaplaceStrategy(params, fixed_seed=b"\x11" * 16) \
+        .add_noise_to_agg_share(vdaf, share, 10)
+    monkeypatch.setenv("JANUS_DP_HOST_ONLY", "1")
+    host = DiscreteLaplaceStrategy(params, fixed_seed=b"\x11" * 16) \
+        .add_noise_to_agg_share(vdaf, share, 10)
+    assert dev == host
+    assert dev != share
+
+
+def test_strategy_fresh_seeds_differ():
+    from janus_tpu.dp.strategies import DiscreteGaussianStrategy
+
+    params = DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+                      delta_exp=30)
+    s = DiscreteGaussianStrategy(params)
+    vdaf = _FakeVdaf(Field64)
+    share = [0] * 64
+    # 64 buckets of sigma~6.5 noise: two identical draws means the seed
+    # was reused, which is exactly the bug this guards against
+    assert s.add_noise_to_agg_share(vdaf, share, 1) \
+        != s.add_noise_to_agg_share(vdaf, share, 1)
+
+
+# -- calibration + config codecs --------------------------------------------
+
+def test_gaussian_sigma_calibration():
+    params = DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+                      delta_exp=30)
+    num, den = params.sigma()
+    assert den == SIGMA_DENOMINATOR
+    # sigma >= sqrt(2 ln(1.25/delta)) / eps, ceiling-quantized
+    exact = math.sqrt(2 * math.log(1.25 * 2 ** 30))
+    assert num / den == pytest.approx(exact, abs=2 / SIGMA_DENOMINATOR)
+    assert num / den >= exact
+
+
+def test_dp_params_validation():
+    with pytest.raises(ValueError):
+        DpParams("discrete_gaussian", epsilon_num=1)  # missing delta_exp
+    with pytest.raises(ValueError):
+        DpParams("discrete_laplace", epsilon_num=1, delta_exp=30)
+    with pytest.raises(ValueError):
+        DpParams("discrete_laplace", epsilon_num=0)
+
+
+@pytest.mark.parametrize("params", [
+    DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+             delta_exp=30),
+    DpParams("discrete_laplace", epsilon_num=3, epsilon_den=2,
+             sensitivity=4),
+])
+def test_dp_params_json_roundtrip(params):
+    assert DpParams.from_json_obj(params.to_json_obj()) == params
+
+
+@pytest.mark.parametrize("params", [
+    DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+             delta_exp=30),
+    DpParams("discrete_laplace", epsilon_num=3, epsilon_den=2,
+             sensitivity=4),
+])
+def test_dp_mechanism_wire_roundtrip(params):
+    from janus_tpu.messages.taskprov import DpConfig, DpMechanism
+
+    mech = params.to_dp_config().dp_mechanism
+    assert mech.is_recognized
+    decoded = DpMechanism.decode(mech.encode())
+    assert decoded == mech
+    assert DpParams.from_dp_mechanism(decoded) == params
+    assert DpParams.from_dp_mechanism(
+        DpConfig.none().dp_mechanism) is None
+
+
+def test_dp_mechanism_degenerate_rejected():
+    from janus_tpu.messages.codec import DecodeError
+    from janus_tpu.messages.taskprov import DpMechanism
+
+    blob = DpMechanism.discrete_laplace(1).encode()
+    zero_eps = bytes([blob[0]]) + b"\x00\x00\x00\x00" + blob[5:]
+    with pytest.raises(DecodeError):
+        DpMechanism.decode(zero_eps)
+
+
+# -- device merge of shard accumulators -------------------------------------
+
+@pytest.mark.parametrize("field", [Field64, Field128])
+def test_merge_encoded_shares_matches_fold(field):
+    from janus_tpu.engine.merge import merge_encoded_shares
+
+    rng = random.Random(99)
+    n_shards, length = 7, 40
+    vecs = [[rng.randrange(field.MODULUS) for _ in range(length)]
+            for _ in range(n_shards)]
+    blobs = [field.encode_vec(v) for v in vecs]
+    merged = merge_encoded_shares(_FakeVdaf(field), blobs, force=True)
+    assert merged is not None
+    expected = [0] * length
+    for v in vecs:
+        expected = field.vec_add(expected, v)
+    assert merged == expected
+
+
+def test_merge_encoded_shares_range_check():
+    from janus_tpu.engine.merge import merge_encoded_shares
+
+    good = Field64.encode_vec([1, 2, 3])
+    bad = Field64.MODULUS.to_bytes(8, "little") + Field64.encode_vec([4, 5])
+    with pytest.raises(ValueError):
+        merge_encoded_shares(_FakeVdaf(Field64), [good, bad], force=True)
+
+
+def test_merge_encoded_shares_disqualifiers():
+    from janus_tpu.engine.merge import merge_encoded_shares
+
+    v = _FakeVdaf(Field64)
+    blob = Field64.encode_vec([1, 2])
+    assert merge_encoded_shares(v, [blob]) is None  # < 2 shards
+    assert merge_encoded_shares(v, [blob, blob[:-1]]) is None  # misaligned
+    assert merge_encoded_shares(v, [blob, blob]) is None  # below min elems
+    assert merge_encoded_shares(
+        _FakeVdaf(type("F255", (), {"ENCODED_SIZE": 32})), [blob, blob],
+        force=True) is None  # unsupported field
+
+
+# -- persistence + provisioning API -----------------------------------------
+
+def _dp_task_builder(dp_params):
+    from janus_tpu.datastore.task import QueryTypeCfg, TaskBuilder
+    from janus_tpu.models import VdafInstance
+
+    b = TaskBuilder(QueryTypeCfg.time_interval(),
+                    VdafInstance.prio3_histogram(4, 2))
+    b.with_dp_config(dp_params)
+    return b
+
+
+def test_datastore_task_dp_config_roundtrip():
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import ephemeral_datastore
+
+    params = DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+                      delta_exp=30)
+    b = _dp_task_builder(params)
+    task = b.leader_view()
+    assert task.dp_config == params
+    ds = ephemeral_datastore(MockClock())
+    ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+    got = ds.run_tx("get", lambda tx: tx.get_aggregator_task(b.task_id))
+    assert got.dp_config == params
+
+
+def test_aggregator_api_dp_config():
+    import base64
+    import hashlib
+
+    import requests
+
+    from janus_tpu.aggregator_api import AggregatorApi, AggregatorApiServer
+    from janus_tpu.core.auth_tokens import AuthenticationToken
+    from janus_tpu.core.hpke import HpkeKeypair
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import ephemeral_datastore
+
+    def b64(data):
+        return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+    params = DpParams("discrete_laplace", epsilon_num=2)
+    ds = ephemeral_datastore(MockClock())
+    token = AuthenticationToken.random_bearer()
+    api = AggregatorApi(ds, [token], public_dap_url="https://dap.example/")
+    server = AggregatorApiServer(api).start()
+    sess = requests.Session()
+    auth = {"Authorization": f"Bearer {token.token}"}
+    req = {
+        "role": "Leader",
+        "vdaf": {"Prio3Histogram": {"length": 4, "chunk_length": 2}},
+        "vdaf_verify_key": b64(bytes(range(16))),
+        "query_type": "TimeInterval",
+        "peer_aggregator_endpoint": "https://helper.example.com/",
+        "min_batch_size": 10,
+        "time_precision": 3600,
+        "aggregator_auth_token": {"type": "Bearer", "token": "agg-token"},
+        "collector_auth_token_hash": b64(hashlib.sha256(b"col").digest()),
+        "collector_hpke_config": b64(HpkeKeypair.generate(9).config.encode()),
+        "dp_config": params.to_json_obj(),
+    }
+    try:
+        r = sess.post(f"{server.address}/tasks", json=req, headers=auth)
+        assert r.status_code == 200, r.content
+        task = r.json()
+        assert task["dp_config"] == params.to_json_obj()
+        r = sess.get(f"{server.address}/tasks/{task['task_id']}",
+                     headers=auth)
+        assert r.json()["dp_config"] == params.to_json_obj()
+
+        bad = dict(req, dp_config={"mechanism": "nope"},
+                   vdaf_verify_key=b64(bytes(range(16, 32))))
+        assert sess.post(f"{server.address}/tasks", json=bad,
+                         headers=auth).status_code == 400
+    finally:
+        server.stop()
+
+
+# -- end-to-end: noised collection ------------------------------------------
+
+def test_dp_histogram_end_to_end():
+    """Leader and helper each noise their aggregate share; the collector's
+    unsharded result is the plaintext histogram plus two bounded noise
+    draws per bucket (mod p), and the report count stays exact."""
+    from janus_tpu.aggregator import (
+        Aggregator,
+        AggregatorConfig,
+        DapHttpServer,
+    )
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+    )
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+    )
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.datastore import ephemeral_datastore
+    from janus_tpu.messages import Interval, Query, Time
+    from janus_tpu.models import VdafInstance
+
+    params = DpParams("discrete_gaussian", epsilon_num=1, epsilon_den=1,
+                      delta_exp=30)
+    tail = params.table().tail
+    measurements = [0, 1, 1, 3]
+    truth = [1, 2, 0, 1]
+
+    vdaf_instance = VdafInstance.prio3_histogram(4, 2)
+    b = _dp_task_builder(params)
+    b.with_min_batch_size(len(measurements))
+    clock = MockClock(Time(1_700_000_000))
+
+    helper_ds = ephemeral_datastore(clock)
+    helper_server = DapHttpServer(Aggregator(
+        helper_ds, clock,
+        AggregatorConfig(batch_aggregation_shard_count=3))).start()
+    leader_ds = ephemeral_datastore(clock)
+    leader_agg = Aggregator(leader_ds, clock,
+                            AggregatorConfig(batch_aggregation_shard_count=3))
+    leader_server = DapHttpServer(leader_agg).start()
+    try:
+        b.helper_endpoint = helper_server.address
+        b.leader_endpoint = leader_server.address
+        helper_ds.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(b.helper_view()))
+        leader_ds.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(b.leader_view()))
+
+        client = Client(
+            ClientParameters(b.task_id, leader_server.address,
+                             helper_server.address, b.time_precision),
+            vdaf_instance, clock=clock)
+        for m in measurements:
+            client.upload(m)
+        leader_agg.report_writer.flush()
+
+        creator = AggregationJobCreator(
+            leader_ds, min_aggregation_job_size=1, max_aggregation_job_size=8)
+        assert creator.run_once() >= 1
+        agg_driver = AggregationJobDriver(leader_ds,
+                                          batch_aggregation_shard_count=3)
+        JobDriver(JobDriverConfig(max_concurrent_job_workers=4),
+                  agg_driver.acquirer, agg_driver.stepper).run_once()
+
+        interval = Interval(clock.now().round_down(b.time_precision),
+                            b.time_precision)
+        query = Query.time_interval(interval)
+        collector = Collector(b.task_id, leader_server.address,
+                              b.collector_auth_token, b.collector_keypair,
+                              vdaf_instance)
+        job_id = collector.start_collection(query)
+        coll_driver = CollectionJobDriver(leader_ds)
+        assert JobDriver(JobDriverConfig(max_concurrent_job_workers=2),
+                         coll_driver.acquirer, coll_driver.stepper) \
+            .run_once() == 1
+
+        result = collector.poll_once(job_id, query)
+        assert result is not None
+        assert result.report_count == len(measurements)
+
+        p = Field128.MODULUS
+        # each bucket carries two independent draws (leader + helper),
+        # each bounded by the table tail
+        diffs = [(got - want) % p
+                 for got, want in zip(result.aggregate_result, truth)]
+        assert all(d <= 2 * tail or d >= p - 2 * tail for d in diffs)
+        # all 8 draws zero has probability ~2e-10: noise must be visible
+        assert result.aggregate_result != truth
+    finally:
+        helper_server.stop()
+        leader_server.stop()

@@ -485,6 +485,89 @@ def test_secret_leak_cut_by_sanitizer():
         [("janus_tpu/core/kx.py", GOOD_TAINT_SANITIZED)]) == []
 
 
+# -- DP noise seeds are secret sources (a logged seed de-noises the
+# published aggregate: the collector subtracts the reproducible draw) ----
+
+BAD_DP_SEED_RETURN = """
+import logging
+import secrets
+
+log = logging.getLogger(__name__)
+
+
+def fresh_noise_seed():
+    return secrets.token_bytes(16)
+
+
+def noise_share(share):
+    s = fresh_noise_seed()
+    log.info("noising share with %s", s)
+    return share, s
+"""
+
+GOOD_DP_SEED_RETURN = """
+import hashlib
+import logging
+import secrets
+
+log = logging.getLogger(__name__)
+
+
+def fresh_noise_seed():
+    return secrets.token_bytes(16)
+
+
+def noise_share(share):
+    s = fresh_noise_seed()
+    log.info("noising share, seed fp %s", hashlib.sha256(s).hexdigest())
+    return share, s
+"""
+
+
+def test_dp_noise_seed_return_is_secret():
+    """fresh_noise_seed()'s return is tainted even when the local it
+    lands in has no tell-tale name."""
+    fs = dataflow_findings(
+        [("janus_tpu/dp/strategies.py", BAD_DP_SEED_RETURN)])
+    assert [f.rule for f in fs] == ["secret-leak"]
+
+
+def test_dp_noise_seed_return_fingerprint_ok():
+    assert dataflow_rules(
+        [("janus_tpu/dp/strategies.py", GOOD_DP_SEED_RETURN)]) == []
+
+
+BAD_DP_SEED_NAME = """
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def record_draw(task, noise_seed):
+    log.warning("task %s drew noise from %s", task, noise_seed)
+"""
+
+GOOD_DP_SEED_NAME = """
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def record_draw(task, noise_seed):
+    log.warning("task %s drew %d-byte noise seed", task, len(noise_seed))
+"""
+
+
+def test_dp_noise_seed_name_is_secret():
+    fs = dataflow_findings([("janus_tpu/dp/noising.py", BAD_DP_SEED_NAME)])
+    assert [f.rule for f in fs] == ["secret-leak"]
+
+
+def test_dp_noise_seed_name_len_ok():
+    assert dataflow_rules(
+        [("janus_tpu/dp/noising.py", GOOD_DP_SEED_NAME)]) == []
+
+
 BAD_RETRACE = """
 import jax
 import jax.numpy as jnp
