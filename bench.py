@@ -721,6 +721,92 @@ def bench_dp_histogram(smoke: bool) -> dict:
     }
 
 
+def bench_multichip(smoke: bool) -> dict:
+    """Meshed data plane (engine/mesh.py): reports/s vs shard count.
+
+    Times the same helper-init workload on a MeshEngine over the first k
+    devices (k = 1 is the plain single-device engine) with the shard
+    floor lowered so the bench batch actually shards; per-shard
+    time_split (lanes, launches, transfer seconds, link weather) comes
+    from the shard snapshots and the profiler's per-shard totals.  The
+    headline is the best shard count's rate, so the section rides the
+    bench-diff gate like any other config.  Skips cleanly on a
+    single-device host."""
+    from janus_tpu import profiler
+    from janus_tpu.engine.mesh import MeshEngine
+
+    devs = list(jax.devices())
+    out: dict = {"device_count": len(devs),
+                 "platform": getattr(devs[0], "platform", "?")}
+    if len(devs) < 2:
+        out["skipped"] = "single-device host: mesh plane inactive"
+        return out
+    vdaf = prio3.new_count() if smoke else prio3.new_sum_vec(100, 8, 10)
+    batch = 4096 if smoke else 16384
+    total = 2 * batch
+    verify_key = bytes(range(vdaf.VERIFY_KEY_SIZE))
+    nonces, pubs, shares, inits = make_base_reports(
+        vdaf, 1 if smoke else [1] * 100, 16, verify_key)
+    nonces, pubs, shares, inits = (
+        tile(xs, batch) for xs in (nonces, pubs, shares, inits))
+    # one shared inner engine: jax caches one executable per (bucket,
+    # device), so the compile cost amortizes across the k sweep
+    inner = BatchPrio3(vdaf)
+    ks, k = [], 1
+    while k < len(devs):
+        ks.append(k)
+        k *= 2
+    ks.append(len(devs))
+    scaling: dict = {}
+    best_rps, best_k = 0.0, 1
+    for k in ks:
+        if k == 1:
+            eng = inner
+        else:
+            eng = MeshEngine(inner, devices=devs[:k])
+            # the bench batch must shard k ways (prod floor is 2048)
+            eng._min_shard = max(64, batch // (2 * k))
+        before = profiler.shards_summary()
+        rps, rounds, n_bad = time_batches(
+            eng, verify_key, nonces, pubs, shares, inits, batch, total)
+        entry: dict = {
+            "reports_per_sec": round(rps, 1),
+            "rounds": [round(r, 1) for r in rounds],
+            "failed_lanes_warmup": n_bad,
+        }
+        if k > 1:
+            after = profiler.shards_summary()
+            per_shard = []
+            for s in eng.shards_snapshot():
+                dev = s["device"]
+                a = after.get(dev, {}).get("helper_init", {})
+                b = before.get(dev, {}).get("helper_init", {})
+                per_shard.append({
+                    "device": dev,
+                    "lanes": s["device_lanes"],
+                    "launches": (a.get("launches", 0)
+                                 - b.get("launches", 0)),
+                    "transfer_s": round(a.get("transfer_s", 0.0)
+                                        - b.get("transfer_s", 0.0), 4),
+                    "link": s["link"],
+                })
+            entry["per_shard"] = per_shard
+        scaling[str(k)] = entry
+        if rps > best_rps:
+            best_rps, best_k = rps, k
+    out.update({
+        "batch_size": batch,
+        "total_reports_per_iter": total,
+        "scaling": scaling,
+        "best_shards": best_k,
+        "reports_per_sec": round(best_rps, 1),
+        "speedup_vs_single_shard": round(
+            best_rps / scaling["1"]["reports_per_sec"], 3)
+        if scaling["1"]["reports_per_sec"] else None,
+    })
+    return out
+
+
 def main():
     smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
     only = os.environ.get("BENCH_CONFIGS")
@@ -769,6 +855,13 @@ def main():
         except Exception as e:
             _cpu_fallback_if_backend_error(e)
             detail["Prio3Histogram4096DP"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if only is None or "MeshedDataPlane" in only:
+        try:
+            detail["MeshedDataPlane"] = bench_multichip(smoke)
+        except Exception as e:
+            _cpu_fallback_if_backend_error(e)
+            detail["MeshedDataPlane"] = {"error": f"{type(e).__name__}: {e}"}
 
     for name, factory, meas, total, batch in make_configs(smoke):
         if only and name not in only:

@@ -8,5 +8,6 @@ preserved (SURVEY.md §7 hard part 3).
 """
 
 from janus_tpu.engine.batch import BatchPrio3, PreparedReport
+from janus_tpu.engine.mesh import MeshEngine
 
-__all__ = ["BatchPrio3", "PreparedReport"]
+__all__ = ["BatchPrio3", "MeshEngine", "PreparedReport"]

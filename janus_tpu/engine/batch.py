@@ -307,17 +307,24 @@ class BatchPrio3:
         return fn
 
     def _stage(self, arrays: tuple[Any, ...],
-               timed: bool) -> tuple[tuple[Any, ...], float]:
+               timed: bool, device: Any = None,
+               link: Any = None) -> tuple[tuple[Any, ...], float]:
         """Async-stage host arrays into HBM with explicit jax.device_put.
 
         `timed` blocks on completion and feeds the link estimator — used
         for the first chunk of a launch (nothing to overlap with yet) and
         for single launches; later chunks stage un-timed so their
-        transfers overlap the previous chunk's kernel.  Returns
-        (device_arrays, upload_seconds)."""
+        transfers overlap the previous chunk's kernel.  `device` targets a
+        specific mesh shard (the default is jax's default device); `link`
+        is the estimator to feed — a per-device one under the mesh, the
+        process-wide LINK otherwise.  Returns (device_arrays,
+        upload_seconds)."""
         t0 = time.monotonic()
         try:
-            staged = tuple(jax.device_put(a) for a in arrays)
+            if device is None:
+                staged = tuple(jax.device_put(a) for a in arrays)
+            else:
+                staged = tuple(jax.device_put(a, device) for a in arrays)
             if not timed:
                 return staged, 0.0
             for d in staged:
@@ -331,11 +338,12 @@ class BatchPrio3:
             resilient.raise_if_backend_error(e)
             raise
         dt = time.monotonic() - t0
-        streaming.LINK.record_up(sum(a.nbytes for a in arrays), dt)
+        (link or streaming.LINK).record_up(
+            sum(a.nbytes for a in arrays), dt)
         return staged, dt
 
-    def _fetch(self, device_arrays: tuple[Any, ...]
-               ) -> tuple[tuple[Any, ...], float, float]:
+    def _fetch(self, device_arrays: tuple[Any, ...],
+               link: Any = None) -> tuple[tuple[Any, ...], float, float]:
         """Materialize host-bound outputs with the compute wait split from
         the transfer: block first (kernel time attributes to the device
         phase), then time the pure fetch and feed the link estimator.
@@ -353,7 +361,8 @@ class BatchPrio3:
             resilient.raise_if_backend_error(e)
             raise
         t2 = time.monotonic()
-        streaming.LINK.record_down(sum(a.nbytes for a in out), t2 - t1)
+        (link or streaming.LINK).record_down(
+            sum(a.nbytes for a in out), t2 - t1)
         return out, t1 - t0, t2 - t1
 
     def _jit(self, kernel: Any, n_sharded_args: int,
@@ -685,6 +694,74 @@ class BatchPrio3:
         nonce_rows[:N] = nonces_arr(nonces)
         return packed, lverif, decode_err
 
+    def _pack_leader_inputs(self, M: int, verify_key: Any,
+                            nonces: list[bytes],
+                            public_shares: list[bytes],
+                            input_shares: list[bytes],
+                            ) -> tuple[Any, Any, Any, dict[int, str]]:
+        """Host-side packing for the leader kernel: bundled byte tensor
+        (vk | blinds | nonces | pub1) + measurement and proof limbs +
+        per-lane decode errors.  Split out of leader_init_batch so the
+        mesh plane (engine/mesh.py) can pack per-shard slices and drive
+        its own per-device dispatch."""
+        N = len(nonces)
+        per_report_vk = not isinstance(verify_key, (bytes, bytearray))
+        ss = self.vdaf.SEED_SIZE
+        ks = self.vdaf.VERIFY_KEY_SIZE
+        meas_raw = np.zeros((M, self.flp.MEAS_LEN, self.L), dtype=np.uint32)
+        proofs_raw = np.zeros((M, self.P * self.flp.PROOF_LEN, self.L),
+                              dtype=np.uint32)
+        # bundled byte tensor: vk | blinds | nonces | pub1 (see _leader_fn)
+        packed = np.zeros((M, ks + 2 * ss + 16), dtype=np.uint8)
+        vk = packed[:, :ks]
+        blinds = packed[:, ks:ks + ss]
+        nonce_rows = packed[:, ks + ss:ks + ss + 16]
+        pub1 = packed[:, ks + ss + 16:]
+        decode_err: dict[int, str] = {}
+
+        # Vectorized decode of the leader input share layout
+        # meas || proofs || blind (prio3.encode_input_share): length-scan,
+        # then one bulk frombuffer + range check over well-formed reports.
+        es = self.field.ENCODED_SIZE
+        n_meas = self.flp.MEAS_LEN * es
+        n_proof = self.P * self.flp.PROOF_LEN * es
+        ishare_len = n_meas + n_proof + (ss if self.has_jr else 0)
+        pub_len = self.vdaf.shares * ss if self.has_jr else 0
+        good: list[int] = []
+        for i in range(N):
+            if len(input_shares[i]) != ishare_len:
+                decode_err[i] = "bad leader input share length"
+            elif len(public_shares[i]) != pub_len:
+                decode_err[i] = ("bad public share length" if self.has_jr
+                                 else "unexpected public share bytes")
+            else:
+                good.append(i)
+        if good:
+            gi = np.asarray(good)
+            ish = _bytes_rows([input_shares[i] for i in good], ishare_len)
+            mlimbs, ok1 = self._decode_field_vec_batch(ish[:, :n_meas],
+                                                       self.flp.MEAS_LEN)
+            plimbs, ok2 = self._decode_field_vec_batch(
+                ish[:, n_meas : n_meas + n_proof], self.P * self.flp.PROOF_LEN
+            )
+            meas_raw[gi] = mlimbs
+            proofs_raw[gi] = plimbs
+            if self.has_jr:
+                blinds[gi] = ish[:, n_meas + n_proof :]
+                pubs = _bytes_rows([public_shares[i] for i in good], pub_len)
+                pub1[gi] = pubs[:, ss : 2 * ss]
+            in_range = ok1 & ok2
+            for k, i in enumerate(good):
+                if not in_range[k]:
+                    decode_err[i] = "input share element out of range"
+
+        if per_report_vk:
+            vk[:N] = _bytes_rows(list(verify_key), ks)
+        else:
+            vk[:N] = np.frombuffer(verify_key, dtype=np.uint8)
+        nonce_rows[:N] = nonces_arr(nonces)
+        return packed, meas_raw, proofs_raw, decode_err
+
     def device_resident_rate(self, verify_key: Any, nonces: list[bytes],
                              public_shares: list[bytes],
                              input_shares: list[bytes],
@@ -763,7 +840,6 @@ class BatchPrio3:
         # for a bucket shape pays the XLA compile inside the kernel call
         cold = (any(c not in self._helper_fns for c in chunk_sizes)
                 if chunk_sizes else M not in self._helper_fns)
-        ss = self.vdaf.SEED_SIZE
         packed, lverif, decode_err = self._pack_helper_inputs(
             M, verify_key, nonces, public_shares, input_shares,
             inbound_messages)
@@ -819,18 +895,46 @@ class BatchPrio3:
             # pre-streaming data plane — output shares bounce through the
             # host and aggregation re-uploads them
             out_share_d = np.asarray(out_share_d)
-        msg_seed = packed_out[:, :ss]
-        proof_ok = packed_out[:, ss].astype(bool)
-        jr_ok = packed_out[:, ss + 1].astype(bool)
-        fallback = packed_out[:, ss + 2].astype(bool)
         t_dev = time.monotonic()
+        out = self._assemble_helper(
+            N, decode_err, packed_out, out_share_d, vk_for, nonces,
+            public_shares, input_shares, inbound_messages)
+        t_end = time.monotonic()
+        with self._timings_lock:
+            tm = self.timings
+            tm["decode"] += t0 - t_begin
+            tm["device"] += t_dev - t0
+            tm["encode"] += t_end - t_dev
+            tm["batches"] += 1
+        profiler.record_batch(
+            "helper_init", type(self.vdaf).__name__, bucket=M, reports=N,
+            decode_s=t0 - t_begin,
+            device_s=max(t_dev - t0 - transfer_s, 0.0),
+            encode_s=t_end - t_dev, transfer_s=transfer_s,
+            compile_state="cold" if cold else "warm")
+        return out
 
-        # Assembly: per-report Python is the GIL-bound bracket around the
-        # kernel, so keep it lean — one .tolist()/.tobytes() per array
-        # (numpy scalar indexing costs ~100x a list index in this loop).
-        proof_ok_l = proof_ok.tolist()
-        jr_ok_l = jr_ok.tolist()
-        fallback_l = fallback.tolist()
+    def _assemble_helper(self, N: int, decode_err: dict[int, str],
+                         packed_out: Any, out_share_d: Any,
+                         vk_for: Any, nonces: list[bytes],
+                         public_shares: list[bytes],
+                         input_shares: list[bytes],
+                         inbound_messages: Any) -> list[PreparedReport]:
+        """Per-report result assembly for the helper kernel outputs.
+
+        Split out of helper_init_batch so the mesh plane can assemble each
+        shard's slice against that shard's device-resident tensors.  Lane
+        indices are LOCAL to `packed_out`/`out_share_d` (a shard passes its
+        own slice views and shard-resident outputs).
+
+        Assembly: per-report Python is the GIL-bound bracket around the
+        kernel, so keep it lean — one .tolist()/.tobytes() per array
+        (numpy scalar indexing costs ~100x a list index in this loop)."""
+        ss = self.vdaf.SEED_SIZE
+        msg_seed = packed_out[:, :ss]
+        proof_ok_l = packed_out[:, ss].astype(bool).tolist()
+        jr_ok_l = packed_out[:, ss + 1].astype(bool).tolist()
+        fallback_l = packed_out[:, ss + 2].astype(bool).tolist()
         seed_blob = msg_seed.tobytes() if self.has_jr else b""
         ss_row = msg_seed.shape[1] if self.has_jr else 0
         FINISH = ping_pong.PingPongMessage.TYPE_FINISH
@@ -861,19 +965,6 @@ class BatchPrio3:
                 device_shares=out_share_d if self.streaming else None,
                 lane=i if self.streaming else None,
             ))
-        t_end = time.monotonic()
-        with self._timings_lock:
-            tm = self.timings
-            tm["decode"] += t0 - t_begin
-            tm["device"] += t_dev - t0
-            tm["encode"] += t_end - t_dev
-            tm["batches"] += 1
-        profiler.record_batch(
-            "helper_init", type(self.vdaf).__name__, bucket=M, reports=N,
-            decode_s=t0 - t_begin,
-            device_s=max(t_dev - t0 - transfer_s, 0.0),
-            encode_s=t_end - t_dev, transfer_s=transfer_s,
-            compile_state="cold" if cold else "warm")
         return out
 
     def leader_init_batch(
@@ -914,58 +1005,8 @@ class BatchPrio3:
         M = sum(chunk_sizes) if chunk_sizes else self._bucket(N)
         cold = (any(c not in self._leader_fns for c in chunk_sizes)
                 if chunk_sizes else M not in self._leader_fns)
-        ss = self.vdaf.SEED_SIZE
-        ks = self.vdaf.VERIFY_KEY_SIZE
-        meas_raw = np.zeros((M, self.flp.MEAS_LEN, self.L), dtype=np.uint32)
-        proofs_raw = np.zeros((M, self.P * self.flp.PROOF_LEN, self.L), dtype=np.uint32)
-        # bundled byte tensor: vk | blinds | nonces | pub1 (see _leader_fn)
-        packed = np.zeros((M, ks + 2 * ss + 16), dtype=np.uint8)
-        vk = packed[:, :ks]
-        blinds = packed[:, ks:ks + ss]
-        nonce_rows = packed[:, ks + ss:ks + ss + 16]
-        pub1 = packed[:, ks + ss + 16:]
-        decode_err: dict[int, str] = {}
-
-        # Vectorized decode of the leader input share layout
-        # meas || proofs || blind (prio3.encode_input_share): length-scan,
-        # then one bulk frombuffer + range check over well-formed reports.
-        es = self.field.ENCODED_SIZE
-        n_meas = self.flp.MEAS_LEN * es
-        n_proof = self.P * self.flp.PROOF_LEN * es
-        ishare_len = n_meas + n_proof + (ss if self.has_jr else 0)
-        pub_len = self.vdaf.shares * ss if self.has_jr else 0
-        good: list[int] = []
-        for i in range(N):
-            if len(input_shares[i]) != ishare_len:
-                decode_err[i] = "bad leader input share length"
-            elif len(public_shares[i]) != pub_len:
-                decode_err[i] = ("bad public share length" if self.has_jr
-                                 else "unexpected public share bytes")
-            else:
-                good.append(i)
-        if good:
-            gi = np.asarray(good)
-            ish = _bytes_rows([input_shares[i] for i in good], ishare_len)
-            mlimbs, ok1 = self._decode_field_vec_batch(ish[:, :n_meas], self.flp.MEAS_LEN)
-            plimbs, ok2 = self._decode_field_vec_batch(
-                ish[:, n_meas : n_meas + n_proof], self.P * self.flp.PROOF_LEN
-            )
-            meas_raw[gi] = mlimbs
-            proofs_raw[gi] = plimbs
-            if self.has_jr:
-                blinds[gi] = ish[:, n_meas + n_proof :]
-                pubs = _bytes_rows([public_shares[i] for i in good], pub_len)
-                pub1[gi] = pubs[:, ss : 2 * ss]
-            in_range = ok1 & ok2
-            for k, i in enumerate(good):
-                if not in_range[k]:
-                    decode_err[i] = "input share element out of range"
-
-        if per_report_vk:
-            vk[:N] = _bytes_rows(list(verify_key), ks)
-        else:
-            vk[:N] = np.frombuffer(verify_key, dtype=np.uint8)
-        nonce_rows[:N] = nonces_arr(nonces)
+        packed, meas_raw, proofs_raw, decode_err = self._pack_leader_inputs(
+            M, verify_key, nonces, public_shares, input_shares)
         t0 = time.monotonic()
         transfer_s = 0.0
         # The leader's verifier IS wire payload (PrepareInit prep share), so
@@ -1012,6 +1053,35 @@ class BatchPrio3:
             # non-streamed mode: output shares bounce through the host
             out_share_d = np.asarray(out_share_d)
         t_dev = time.monotonic()
+        out = self._assemble_leader(
+            N, decode_err, verif_raw, packed_out, out_share_d, vk_for,
+            nonces, public_shares, input_shares)
+        t_end = time.monotonic()
+        with self._timings_lock:
+            tm = self.timings
+            tm["decode"] += t0 - t_begin
+            tm["device"] += t_dev - t0
+            tm["encode"] += t_end - t_dev
+            tm["batches"] += 1
+        profiler.record_batch(
+            "leader_init", type(self.vdaf).__name__, bucket=M, reports=N,
+            decode_s=t0 - t_begin,
+            device_s=max(t_dev - t0 - transfer_s, 0.0),
+            encode_s=t_end - t_dev, transfer_s=transfer_s,
+            compile_state="cold" if cold else "warm")
+        return out
+
+    def _assemble_leader(self, N: int, decode_err: dict[int, str],
+                         verif_raw: Any, packed_out: Any, out_share_d: Any,
+                         vk_for: Any, nonces: list[bytes],
+                         public_shares: list[bytes],
+                         input_shares: list[bytes]) -> list[PreparedReport]:
+        """Per-report result assembly for the leader kernel outputs.
+
+        Split out of leader_init_batch for the mesh plane; lane indices
+        are LOCAL to the passed tensors (a shard passes its own slices and
+        shard-resident outputs)."""
+        ss = self.vdaf.SEED_SIZE
         own_part = packed_out[:, :ss]
         state_seed = packed_out[:, ss:2 * ss]
         fallback = packed_out[:, 2 * ss].astype(bool)
@@ -1045,19 +1115,6 @@ class BatchPrio3:
                 device_shares=out_share_d if self.streaming else None,
                 lane=i if self.streaming else None,
             ))
-        t_end = time.monotonic()
-        with self._timings_lock:
-            tm = self.timings
-            tm["decode"] += t0 - t_begin
-            tm["device"] += t_dev - t0
-            tm["encode"] += t_end - t_dev
-            tm["batches"] += 1
-        profiler.record_batch(
-            "leader_init", type(self.vdaf).__name__, bucket=M, reports=N,
-            decode_s=t0 - t_begin,
-            device_s=max(t_dev - t0 - transfer_s, 0.0),
-            encode_s=t_end - t_dev, transfer_s=transfer_s,
-            compile_state="cold" if cold else "warm")
         return out
 
     # -- host fallbacks ----------------------------------------------------

@@ -184,11 +184,16 @@ class CoalescingEngine:
         if lane_bytes is None:
             return
         tuned: dict[str, tuple[int, float]] = {}
+        # under a meshed inner engine the launch budget scales with the
+        # number of shards currently serving on device: k live devices
+        # upload and compute k slices concurrently
+        shards = getattr(self.inner, "live_shards", 1)
         for kind in ("helper", "leader"):
             mb, delay_ms = streaming.recommend_coalesce_params(
                 streaming.LINK, lane_bytes(kind),
                 default_max_batch=self._tune_defaults[0],
-                default_delay_ms=self._tune_defaults[1])
+                default_delay_ms=self._tune_defaults[1],
+                shards=shards)
             tuned[kind] = (mb, delay_ms / 1000.0)
         with self._lock:
             self._tuned = tuned

@@ -295,7 +295,18 @@ class FusedHelperInit:
             return None
 
         t_begin = time.perf_counter()
-        M = hpke_device._bucket(n)
+        # chunk the bundled tensor when the link estimate says the upload
+        # is long enough to hide behind chunked compute (the same adaptive
+        # plan as the unfused streaming path); the fused bucket then sits
+        # on the chunk grid instead of one monolithic _bucket(n)
+        chunks = None
+        width = 24 + 32 + cl + pl + ml
+        if getattr(e, "streaming", False):
+            from janus_tpu.engine import streaming
+
+            chunks = streaming.adaptive_chunk_plan(
+                n, width, min_chunk=getattr(e, "_CHUNK_MIN", 8192))
+        M = sum(chunks) if chunks else hpke_device._bucket(n)
         ks = e.vdaf.VERIFY_KEY_SIZE
         body_arr = np.frombuffer(body, np.uint8)
         const_row = np.zeros((1, 161 + ks), np.uint8)
@@ -321,19 +332,22 @@ class FusedHelperInit:
         gather(2, pl, 56 + cl)      # public share
         gather(9, ml, 56 + cl + pl)  # leader ping-pong message
         with self._lock:
-            cold = (M, cl, pl, ml) not in self._fns
-        fn = self._fn(M, cl, pl, ml)
+            cold = (any((c, cl, pl, ml) not in self._fns for c in chunks)
+                    if chunks else (M, cl, pl, ml) not in self._fns)
+        fns = ([self._fn(c, cl, pl, ml) for c in chunks] if chunks
+               else [self._fn(M, cl, pl, ml)])
         t_pack = time.perf_counter()
         from janus_tpu.engine import resilient
 
         try:
-            return self._dispatch(e, fn, const_row, lanes, n, ss, M, cold,
-                                  t_begin, t_pack)
+            return self._dispatch(e, fns, chunks, const_row, lanes, n, ss,
+                                  M, cold, t_begin, t_pack)
         except Exception as err:
             resilient.raise_if_backend_error(err)
             raise
 
-    def _dispatch(self, e: Any, fn: Any, const_row: Any, lanes: Any,
+    def _dispatch(self, e: Any, fns: list, chunks: list[int] | None,
+                  const_row: Any, lanes: Any,
                   n: int, ss: int, M: int, cold: bool,
                   t_begin: float, t_pack: float) -> FusedLaunch:
         t_up = 0.0
@@ -343,19 +357,48 @@ class FusedHelperInit:
             # cleanly brackets kernel time for the profiler split
             from janus_tpu.engine import streaming
 
-            const_d = jax.device_put(const_row)
-            lanes_d = jax.device_put(lanes)
-            # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: the blocking wait IS the link observation fed to LINK.record_up below
-            const_d.block_until_ready()
-            # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: see previous line
-            lanes_d.block_until_ready()
-            t_up = time.perf_counter() - t_pack
-            streaming.LINK.record_up(const_row.nbytes + lanes.nbytes, t_up)
-            t_dispatch = time.perf_counter()
-            out_d, share_d = fn(const_d, lanes_d)
+            if chunks:
+                # double-buffered: only chunk 0's upload is exposed (and
+                # timed — it IS the link observation); each later chunk's
+                # device_put is issued right after the previous chunk's
+                # kernel dispatch, so its transfer overlaps that kernel
+                offs = [0]
+                for c in chunks[:-1]:
+                    offs.append(offs[-1] + c)
+                const_d = jax.device_put(const_row)
+                chunk_d = jax.device_put(lanes[:chunks[0]])
+                # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: the blocking wait IS the link observation fed to LINK.record_up below
+                const_d.block_until_ready()
+                # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: see previous line
+                chunk_d.block_until_ready()
+                t_up = time.perf_counter() - t_pack
+                streaming.LINK.record_up(
+                    const_row.nbytes + chunks[0] * lanes.shape[1], t_up)
+                t_dispatch = time.perf_counter()
+                parts = []
+                for k, c in enumerate(chunks):
+                    parts.append(fns[k](const_d, chunk_d))
+                    if k + 1 < len(chunks):
+                        o = offs[k + 1]
+                        chunk_d = jax.device_put(lanes[o:o + chunks[k + 1]])
+                out_d, share_d = e._concat_fn(tuple(chunks),
+                                              axes=(0, -1))(
+                    *[p[j] for j in range(2) for p in parts])
+            else:
+                const_d = jax.device_put(const_row)
+                lanes_d = jax.device_put(lanes)
+                # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: the blocking wait IS the link observation fed to LINK.record_up below
+                const_d.block_until_ready()
+                # janus-lint: disable=hot-path-sync -- deliberate timed-staging boundary: see previous line
+                lanes_d.block_until_ready()
+                t_up = time.perf_counter() - t_pack
+                streaming.LINK.record_up(const_row.nbytes + lanes.nbytes,
+                                         t_up)
+                t_dispatch = time.perf_counter()
+                out_d, share_d = fns[0](const_d, lanes_d)
         else:
             t_dispatch = t_pack
-            out_d, share_d = fn(const_row, lanes)
+            out_d, share_d = fns[0](const_row, lanes)
         return FusedLaunch(out_d, share_d, n, ss, e.has_jr, profile={
             "vdaf": type(e.vdaf).__name__, "bucket": M,
             "decode_s": t_pack - t_begin, "t_dispatch": t_dispatch,

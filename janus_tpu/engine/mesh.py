@@ -1,0 +1,756 @@
+"""Meshed data plane: shard the prepare/aggregate serving path across chips.
+
+The prepare workload is embarrassingly parallel over reports (every lane
+of the batched kernels depends only on its own report's shares), and the
+multichip harness proved the helper handler byte-identical on an 8-device
+mesh — but until this module the *serving* plane was single-device.  Here
+the existing engines serve sharded:
+
+  * ``MeshPlan`` splits each coalesced launch along the report axis into
+    one contiguous slice per live device.  Each shard gets its own
+    ``LinkBandwidthEstimator`` (per-device `janus_link_*` gauges) feeding
+    a per-device ``adaptive_chunk_plan``, and stages with double-buffered
+    chunks: chunk k+1's ``jax.device_put`` to shard d overlaps shard d's
+    kernel for chunk k.
+  * Dispatch is MPMD-style, not SPMD: every shard runs an INDEPENDENT
+    jitted program on arrays committed to its device.  An SPMD collective
+    program would fail globally when one device dies; independent per-
+    shard programs give each device its own failure domain, which is what
+    makes per-shard resilience possible at all.
+  * Per-shard resilience: a classified backend failure on one device
+    demotes ONLY that shard — its lanes from the observing call are
+    re-served through the bit-identical host oracle (zero report loss),
+    later launches plan around it, and a per-shard probe thread
+    re-promotes it with backoff (same JANUS_ENGINE_PROBE_* knobs as the
+    whole-engine breaker in engine/resilient.py).  The whole-plane
+    ResilientEngine above this wrapper never sees a single-shard fault.
+  * ``aggregate_raw_rows`` is meshed: each referenced init batch reduces
+    to one [L, OUT] partial in its own shard's HBM, the partials are
+    assembled into one mesh-sharded array and combined by a jitted
+    replicated-output reduce — ONE all-reduce over the interconnect
+    (parallel.partial_reduce_fn); the field vectors never bounce through
+    the host.  Modular addition is associative and exact, so the result
+    is bit-identical to any sequential fold.
+
+Env knobs (docs/MESH.md):
+  JANUS_MESH            auto (default: mesh when >1 device) | 1 | 0
+  JANUS_MESH_DEVICES    cap on the number of devices used
+  JANUS_MESH_MIN_SHARD  min lanes per shard before a launch splits
+                        (default 2048; a launch below 2x this stays on
+                        the inner engine's single-device path)
+
+Multi-host: initialize `jax.distributed` before the first engine is
+built and the same planner shards over all global devices — see
+docs/MESH.md.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from janus_tpu import flight_recorder, metrics, profiler, trace
+from janus_tpu.core.retries import Backoff
+from janus_tpu.engine import resilient, streaming
+from janus_tpu.engine.batch import bucket_size
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def mesh_devices() -> list[Any] | None:
+    """The devices the mesh plane should serve over, or None to stay
+    single-device.  Resolves JANUS_MESH / JANUS_MESH_DEVICES; callers run
+    this AFTER the startup accelerator probe (binaries.py), so a hung
+    backend has already been classified."""
+    mode = os.environ.get("JANUS_MESH", "auto").strip().lower()
+    if mode in ("0", "off", "false", "no"):
+        return None
+    try:
+        import jax
+
+        devs = list(jax.devices())
+    except Exception:
+        return None
+    cap = _env_int("JANUS_MESH_DEVICES", 0)
+    if cap > 0:
+        devs = devs[:cap]
+    if len(devs) < 2:
+        return None
+    return devs
+
+
+def _device_label(dev: Any) -> str:
+    return f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', '?')}"
+
+
+@dataclass
+class ShardPlan:
+    """One device's slice of a launch."""
+
+    index: int          # shard index (stable; the chaos injector targets it)
+    device: Any         # jax device
+    start: int          # first lane of this shard's contiguous slice
+    count: int          # lanes in the slice
+    bucket: int         # kernel batch size (sum of chunks, or bucket_size)
+    chunks: list[int] | None  # per-device double-buffer plan, or None
+
+
+@dataclass
+class MeshPlan:
+    """A launch split along the report axis across the live mesh."""
+
+    n: int
+    shards: list[ShardPlan] = field(default_factory=list)
+
+
+class _Shard:
+    """Per-device breaker state: the mesh-local analog of
+    resilient._Breaker, with its own probe/re-promote lifecycle."""
+
+    def __init__(self, index: int, device: Any, kind: str) -> None:
+        self.index = index
+        self.device = device
+        self.label = _device_label(device)
+        self.kind = kind
+        self.lock = threading.Lock()
+        self.state = "device"  # device | probing | host
+        self.reason: str | None = None
+        self.demoted_at: float | None = None
+        self.demotions = 0
+        self.repromotions = 0
+        self.device_lanes = 0
+        self.host_lanes = 0
+        self.last_probe_error: str | None = None
+        self.wake = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        # Each shard watches its own link: per-device chunk plans track
+        # per-device weather, and the gauges carry the device label.
+        self.link = streaming.LinkBandwidthEstimator(device=self.label)
+        self.set_gauge()
+
+    @property
+    def demoted(self) -> bool:
+        return self.state != "device"
+
+    def set_gauge(self) -> None:
+        # The per-shard samples carry a `device` label; the whole-engine
+        # breaker's (kind, state) samples are a DIFFERENT label set on the
+        # same gauge, so neither clobbers the other.
+        for s in ("device", "probing", "host"):
+            resilient.engine_state.set(1.0 if s == self.state else 0.0,
+                                       kind=self.kind, state=s,
+                                       device=self.label)
+
+    def snapshot(self) -> dict[str, Any]:
+        with self.lock:
+            return {
+                "index": self.index,
+                "device": self.label,
+                "state": self.state,
+                "demoted": self.state != "device",
+                "reason": self.reason,
+                "demoted_for_s": (round(time.monotonic() - self.demoted_at, 3)
+                                  if self.state != "device"
+                                  and self.demoted_at is not None else None),
+                "demotions": self.demotions,
+                "repromotions": self.repromotions,
+                "device_lanes": self.device_lanes,
+                "host_lanes": self.host_lanes,
+                "last_probe_error": self.last_probe_error,
+                "link": self.link.snapshot(),
+            }
+
+
+def probe_shard_device(device: Any, timeout_s: float) -> None:
+    """A tiny committed round trip on ONE device under a watchdog thread
+    (the per-shard analog of resilient.probe_backend): device_put to the
+    shard, add, fetch.  A hang or failure raises BackendUnavailable."""
+    result: dict[str, Any] = {}
+
+    def probe() -> None:
+        try:
+            import jax
+
+            d = jax.device_put(np.arange(8, dtype=np.uint32), device)
+            result["ok"] = int(np.asarray(d + np.uint32(1))[0])
+        except BaseException as e:  # noqa: BLE001 — report, don't swallow
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True,
+                         name=f"shard-probe-{_device_label(device)}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise resilient.BackendUnavailable(
+            f"shard {_device_label(device)} probe timed out after "
+            f"{timeout_s:.0f}s")
+    if "error" in result:
+        raise result["error"]
+
+
+# -- registry (lift_backend_loss wakes shard probes) -------------------------
+
+_mesh_engines: "weakref.WeakSet[MeshEngine]" = weakref.WeakSet()
+_mesh_lock = threading.Lock()
+
+
+def _registered() -> list["MeshEngine"]:
+    with _mesh_lock:
+        return list(_mesh_engines)
+
+
+def wake_probes() -> None:
+    """Nudge every demoted shard's probe thread (resilient.
+    lift_backend_loss calls this so shard re-promotion doesn't wait out
+    the current backoff)."""
+    for eng in _registered():
+        for shard in eng._shards:
+            shard.wake.set()
+
+
+def mesh_snapshot() -> list[dict[str, Any]]:
+    """Per-engine mesh state for /debug/profile."""
+    out = []
+    for eng in _registered():
+        try:
+            out.append({
+                "kind": eng._kind,
+                "devices": [s.label for s in eng._shards],
+                "live_shards": eng.live_shards,
+                "min_shard": eng._min_shard,
+                "shards": eng.shards_snapshot(),
+            })
+        except Exception:
+            continue
+    return out
+
+
+class MeshEngine:
+    """Sharded serving facade over a single-device BatchPrio3.
+
+    The inner engine keeps `mesh=None` — its kernels are per-device
+    programs, and THIS wrapper owns device placement by committing each
+    shard's inputs with `jax.device_put(x, device)`; jax then runs the
+    jitted kernel on the committed device, compiling one executable per
+    (bucket, device).  Launches too small to shard delegate to the inner
+    engine untouched (its own chunking/streaming applies)."""
+
+    def __init__(self, inner: Any, devices: list[Any] | None = None) -> None:
+        if devices is None:
+            devices = mesh_devices()
+        if not devices or len(devices) < 2:
+            raise ValueError("MeshEngine needs at least 2 devices; use the "
+                             "inner engine directly for one")
+        self.inner = inner
+        self._kind = type(inner.vdaf).__name__
+        self._shards = [_Shard(i, d, self._kind)
+                        for i, d in enumerate(devices)]
+        self._min_shard = max(1, _env_int("JANUS_MESH_MIN_SHARD", 2048))
+        # (device-id tuple) -> (mesh, jitted partial reduce) for the
+        # all-reduced aggregate combine
+        self._partial_fns: dict[tuple[int, ...], tuple[Any, Any]] = {}
+        self._partial_lock = threading.Lock()
+        with _mesh_lock:
+            _mesh_engines.add(self)
+
+    # -- facade ------------------------------------------------------------
+
+    @property
+    def vdaf(self) -> Any:
+        return self.inner.vdaf
+
+    @property
+    def device_ok(self) -> bool:
+        return bool(getattr(self.inner, "device_ok", False))
+
+    @property
+    def fallback_count(self) -> int:
+        return self.inner.fallback_count
+
+    @property
+    def timings(self) -> Any:
+        return self.inner.timings
+
+    @timings.setter
+    def timings(self, value: Any) -> None:
+        self.inner.timings = value
+
+    @property
+    def live_shards(self) -> int:
+        """Shards currently serving on device (coalesce.py feeds this to
+        recommend_coalesce_params so the launch budget tracks the live
+        mesh width)."""
+        return sum(1 for s in self._shards if not s.demoted) or 1
+
+    def bind(self, agg_param: bytes) -> "MeshEngine":
+        bound = self.inner.bind(agg_param)
+        if bound is self.inner:
+            return self
+        clone = MeshEngine.__new__(MeshEngine)
+        clone.__dict__.update(self.__dict__)
+        clone.inner = bound
+        return clone
+
+    def __getattr__(self, name: str) -> Any:
+        # non-sharded surface: _bucket, lane_upload_bytes, _host_helper,
+        # leader_finish, aggregate_masked*, field/flp introspection
+        return getattr(self.inner, name)
+
+    def shards_snapshot(self) -> list[dict[str, Any]]:
+        return [s.snapshot() for s in self._shards]
+
+    # -- planning ----------------------------------------------------------
+
+    def plan(self, n: int, kind: str = "helper") -> MeshPlan | None:
+        """Split `n` lanes across the live shards, or None to delegate to
+        the single-device path (launch too small, or <2 live shards)."""
+        live = [s for s in self._shards if not s.demoted]
+        k = min(len(live), n // self._min_shard)
+        if k < 2:
+            return None
+        e = self.inner
+        lane_bytes = e.lane_upload_bytes(kind)
+        base, rem = divmod(n, k)
+        plan = MeshPlan(n)
+        start = 0
+        for j in range(k):
+            count = base + (1 if j < rem else 0)
+            shard = live[j]
+            chunks = None
+            if e.streaming:
+                chunks = streaming.adaptive_chunk_plan(
+                    count, lane_bytes, estimator=shard.link,
+                    min_chunk=e._CHUNK_MIN)
+            bucket = sum(chunks) if chunks else bucket_size(count)
+            plan.shards.append(ShardPlan(shard.index, shard.device, start,
+                                         count, bucket, chunks))
+            start += count
+        return plan
+
+    # -- per-shard breaker -------------------------------------------------
+
+    def _demote_shard(self, shard: _Shard, exc: BaseException,
+                      where: str) -> None:
+        repromote = os.environ.get("JANUS_ENGINE_REPROMOTE", "1") not in (
+            "0", "false")
+        with shard.lock:
+            if shard.state != "device":
+                return
+            shard.state = "probing" if repromote else "host"
+            shard.reason = (f"{type(exc).__name__}: "
+                            f"{(str(exc) or repr(exc)).splitlines()[0][:200]}")
+            shard.demoted_at = time.monotonic()
+            shard.demotions += 1
+            shard.last_probe_error = None
+        shard.set_gauge()
+        resilient.engine_demotions_total.add(1, kind=self._kind,
+                                             device=shard.label)
+        flight_recorder.record(
+            "watchdog_stall", stall="shard_demoted", engine=self._kind,
+            device=shard.label, where=where or None, reason=shard.reason)
+        from janus_tpu import watchdog
+
+        watchdog.watchdog_stalls_total.add(1, kind="shard_demoted")
+        trace.warn("mesh shard demoted to host oracle", kind=self._kind,
+                   device=shard.label, where=where, reason=shard.reason)
+        if repromote:
+            self._start_probe(shard)
+
+    def _start_probe(self, shard: _Shard) -> None:
+        with shard.lock:
+            if (shard._probe_thread is not None
+                    and shard._probe_thread.is_alive()):
+                return
+            shard.wake.clear()
+            t = threading.Thread(
+                target=self._probe_loop, args=(shard,), daemon=True,
+                name=f"shard-repromote-{shard.label}")
+            shard._probe_thread = t
+        t.start()
+
+    def _probe_loop(self, shard: _Shard) -> None:
+        backoff = Backoff(
+            initial_interval=resilient._env_float(
+                "JANUS_ENGINE_PROBE_INITIAL_S", 1.0),
+            max_interval=resilient._env_float(
+                "JANUS_ENGINE_PROBE_MAX_S", 30.0),
+            multiplier=2.0, max_elapsed_time=None)
+        for interval in backoff.intervals():
+            if shard.wake.wait(interval):
+                shard.wake.clear()
+            if shard.state == "device":
+                return
+            try:
+                if resilient.backend_loss_active(shard=shard.index):
+                    raise resilient._chaos_error()
+                probe_shard_device(
+                    shard.device,
+                    resilient._env_float("JANUS_ENGINE_PROBE_TIMEOUT_S",
+                                         20.0))
+            except BaseException as e:  # noqa: BLE001 — any failure = still down
+                with shard.lock:
+                    shard.last_probe_error = (
+                        str(e).splitlines()[0][:200] or repr(e))
+                continue
+            self._promote_shard(shard)
+            return
+
+    def _promote_shard(self, shard: _Shard) -> None:
+        with shard.lock:
+            if shard.state == "device":
+                return
+            demoted_for = (time.monotonic() - shard.demoted_at
+                           if shard.demoted_at is not None else 0.0)
+            shard.state = "device"
+            shard.reason = None
+            shard.demoted_at = None
+            shard.repromotions += 1
+        shard.set_gauge()
+        resilient.engine_repromotions_total.add(1, kind=self._kind,
+                                                device=shard.label)
+        trace.info("mesh shard re-promoted to device path",
+                   kind=self._kind, device=shard.label,
+                   demoted_for_s=round(demoted_for, 3))
+
+    def _count_lanes(self, shard: _Shard, path: str, n: int) -> None:
+        metrics.mesh_shard_reports_total.add(n, device=shard.label,
+                                             path=path)
+        with shard.lock:
+            if path == "device":
+                shard.device_lanes += n
+            else:
+                shard.host_lanes += n
+
+    # -- sharded dispatch --------------------------------------------------
+
+    def _dispatch_shard(self, kind: str, shard: _Shard, ps: ShardPlan,
+                        vk: Any, nonces: list[bytes], pubs: list[bytes],
+                        shares: list[bytes],
+                        inbounds: Any) -> dict[str, Any]:
+        """Pack + stage + launch one shard's slice on its device.  Returns
+        device handles; nothing here blocks on the kernel, so every
+        shard's compute is in flight before the first fetch."""
+        e = self.inner
+        M = ps.bucket
+        t0 = time.monotonic()
+        if kind == "helper":
+            packed, lverif, decode_err = e._pack_helper_inputs(
+                M, vk, nonces, pubs, shares, inbounds)
+            host_arrays: tuple[Any, ...] = (packed, lverif)
+            cold = (any(c not in e._helper_fns for c in ps.chunks)
+                    if ps.chunks else M not in e._helper_fns)
+        else:
+            packed, meas_raw, proofs_raw, decode_err = e._pack_leader_inputs(
+                M, vk, nonces, pubs, shares)
+            host_arrays = (packed, meas_raw, proofs_raw)
+            cold = (any(c not in e._leader_fns for c in ps.chunks)
+                    if ps.chunks else M not in e._leader_fns)
+        t_pack = time.monotonic() - t0
+        fn_for = e._helper_fn if kind == "helper" else e._leader_fn
+        concat_axes = (0, -1) if kind == "helper" else (0, 0, -1)
+        transfer_s = 0.0
+        if ps.chunks:
+            # double-buffered per-device chunks: chunk 0's upload is timed
+            # (it feeds THIS shard's link estimator), then each kernel
+            # dispatch is chased by the async staging of the next chunk so
+            # its device_put overlaps this chunk's kernel on this device
+            offs = [0]
+            for c in ps.chunks[:-1]:
+                offs.append(offs[-1] + c)
+
+            def slices(k: int) -> tuple[Any, ...]:
+                o, c = offs[k], ps.chunks[k]
+                return tuple(a[o:o + c] for a in host_arrays)
+
+            staged, t_up = self.inner._stage(
+                slices(0), timed=True, device=shard.device, link=shard.link)
+            transfer_s += t_up
+            parts: list[Any] = []
+            for k, c in enumerate(ps.chunks):
+                parts.append(fn_for(c)(*staged))
+                if k + 1 < len(ps.chunks):
+                    staged, _ = self.inner._stage(
+                        slices(k + 1), timed=False, device=shard.device)
+            n_out = len(parts[0])
+            outs = self.inner._concat_fn(tuple(ps.chunks),
+                                         axes=concat_axes)(
+                *[p[j] for j in range(n_out) for p in parts])
+        else:
+            staged, t_up = self.inner._stage(
+                host_arrays, timed=True, device=shard.device,
+                link=shard.link)
+            transfer_s += t_up
+            outs = fn_for(M)(*staged)
+        return {"outs": outs, "decode_err": decode_err,
+                "transfer_s": transfer_s, "pack_s": t_pack, "cold": cold}
+
+    def _serve_shard_host(self, kind: str, shard: _Shard, vk_for: Any,
+                          nonces: list[bytes], pubs: list[bytes],
+                          shares: list[bytes], inbounds: Any) -> list[Any]:
+        """Re-serve one shard's slice through the bit-identical host
+        oracle (the inner engine's per-lane host path): the observing call
+        completes with zero report loss while the shard is down."""
+        e = self.inner
+        out = []
+        for i in range(len(nonces)):
+            if kind == "helper":
+                out.append(e._host_helper(vk_for(i), nonces[i], pubs[i],
+                                          shares[i], inbounds[i]))
+            else:
+                out.append(e._host_leader(vk_for(i), nonces[i], pubs[i],
+                                          shares[i]))
+        self._count_lanes(shard, "host", len(nonces))
+        return out
+
+    def _serve_meshed(self, kind: str, plan: MeshPlan, verify_key: Any,
+                      nonces: list[bytes], pubs: list[bytes],
+                      shares: list[bytes], inbounds: Any) -> list[Any]:
+        e = self.inner
+        per_report_vk = not isinstance(verify_key, (bytes, bytearray))
+        t_begin = time.monotonic()
+        shard_args: list[tuple[Any, ...]] = []
+        for ps in plan.shards:
+            lo, hi = ps.start, ps.start + ps.count
+            vk_s = verify_key[lo:hi] if per_report_vk else verify_key
+            shard_args.append((vk_s, nonces[lo:hi], pubs[lo:hi],
+                               shares[lo:hi],
+                               inbounds[lo:hi] if inbounds is not None
+                               else None))
+        results: list[list[Any] | None] = [None] * len(plan.shards)
+        pending: list[tuple[int, _Shard, ShardPlan, dict[str, Any]]] = []
+        host_slots: list[int] = []
+        transfer_s = pack_s = 0.0
+        cold = False
+        # phase 1: dispatch every live shard (kernels run concurrently on
+        # independent devices); a shard that fails here is demoted and its
+        # slot re-served on host in phase 3
+        for slot, ps in enumerate(plan.shards):
+            shard = self._shards[ps.index]
+            if shard.demoted or resilient.backend_loss_active(
+                    shard=ps.index):
+                if not shard.demoted:
+                    self._demote_shard(shard, resilient._chaos_error(),
+                                       f"{kind}_init")
+                host_slots.append(slot)
+                continue
+            try:
+                disp = self._dispatch_shard(kind, shard, ps,
+                                            *shard_args[slot])
+                pack_s += disp["pack_s"]
+                transfer_s += disp["transfer_s"]
+                cold = cold or disp["cold"]
+                pending.append((slot, shard, ps, disp))
+            except BaseException as exc:
+                if resilient.is_backend_error(exc):
+                    self._demote_shard(shard, exc, f"{kind}_init")
+                    host_slots.append(slot)
+                    continue
+                raise
+        t_disp = time.monotonic()
+        # phase 2: fetch + assemble per shard, in order
+        for slot, shard, ps, disp in pending:
+            vk_s = shard_args[slot][0]
+            pvk = not isinstance(vk_s, (bytes, bytearray))
+            vk_for = (lambda i, _vk=vk_s, _p=pvk: _vk[i] if _p else _vk)
+            try:
+                if kind == "helper":
+                    packed_out_d, out_share_d = disp["outs"]
+                    (packed_out,), _w, t_down = e._fetch(
+                        (packed_out_d,), link=shard.link)
+                    transfer_s += t_down
+                    results[slot] = e._assemble_helper(
+                        ps.count, disp["decode_err"], packed_out,
+                        out_share_d, vk_for, *shard_args[slot][1:])
+                else:
+                    verif_raw_d, packed_out_d, out_share_d = disp["outs"]
+                    (verif_raw, packed_out), _w, t_down = e._fetch(
+                        (verif_raw_d, packed_out_d), link=shard.link)
+                    transfer_s += t_down
+                    results[slot] = e._assemble_leader(
+                        ps.count, disp["decode_err"], verif_raw, packed_out,
+                        out_share_d, vk_for, *shard_args[slot][1:4])
+            except BaseException as exc:
+                if resilient.is_backend_error(exc):
+                    self._demote_shard(shard, exc, f"{kind}_fetch")
+                    host_slots.append(slot)
+                    continue
+                raise
+            self._count_lanes(shard, "device", ps.count)
+            profiler.record_shard(
+                shard.label, f"{kind}_init", reports=ps.count,
+                transfer_s=disp["transfer_s"],
+                chunks=len(ps.chunks) if ps.chunks else 1)
+        # phase 3: demoted slots re-serve through the host oracle — the
+        # observing call completes, zero loss
+        for slot in host_slots:
+            ps = plan.shards[slot]
+            shard = self._shards[ps.index]
+            vk_s = shard_args[slot][0]
+            pvk = not isinstance(vk_s, (bytes, bytearray))
+            vk_for = (lambda i, _vk=vk_s, _p=pvk: _vk[i] if _p else _vk)
+            results[slot] = self._serve_shard_host(kind, shard, vk_for,
+                                                   *shard_args[slot][1:])
+        t_end = time.monotonic()
+        out: list[Any] = []
+        for r in results:
+            out.extend(r if r is not None else [])
+        with e._timings_lock:
+            tm = e.timings
+            tm["decode"] += pack_s
+            tm["device"] += t_disp - t_begin - pack_s
+            tm["encode"] += t_end - t_disp
+            tm["batches"] += 1
+        profiler.record_batch(
+            f"{kind}_init", self._kind,
+            bucket=sum(ps.bucket for ps in plan.shards), reports=plan.n,
+            decode_s=pack_s,
+            device_s=max(t_end - t_begin - pack_s - transfer_s, 0.0),
+            encode_s=t_end - t_disp, transfer_s=transfer_s,
+            compile_state="cold" if cold else "warm")
+        return out
+
+    # -- prepare entry points ----------------------------------------------
+
+    def helper_init_batch(self, verify_key: Any, nonces: list[bytes],
+                          public_shares: list[bytes],
+                          input_shares: list[bytes],
+                          inbound_messages: Any) -> list[Any]:
+        plan = (self.plan(len(nonces), "helper")
+                if self.inner.device_ok else None)
+        if plan is None:
+            return self.inner.helper_init_batch(
+                verify_key, nonces, public_shares, input_shares,
+                inbound_messages)
+        return self._serve_meshed("helper", plan, verify_key, nonces,
+                                  public_shares, input_shares,
+                                  inbound_messages)
+
+    def leader_init_batch(self, verify_key: Any, nonces: list[bytes],
+                          public_shares: list[bytes],
+                          input_shares: list[bytes]) -> list[Any]:
+        plan = (self.plan(len(nonces), "leader")
+                if self.inner.device_ok else None)
+        if plan is None:
+            return self.inner.leader_init_batch(
+                verify_key, nonces, public_shares, input_shares)
+        return self._serve_meshed("leader", plan, verify_key, nonces,
+                                  public_shares, input_shares, None)
+
+    def leader_finish(self, reports: list[Any],
+                      inbound_messages: Any) -> list[Any]:
+        return self.inner.leader_finish(reports, inbound_messages)
+
+    # -- meshed aggregation ------------------------------------------------
+
+    def aggregate(self, reports: list[Any]) -> list[int]:
+        rows = [
+            rep.out_share_raw
+            for rep in reports
+            if rep.status == "finished" and rep.out_share_raw is not None
+        ]
+        return self.aggregate_raw_rows(rows)
+
+    def aggregate_raw_rows(self, rows: list[Any]) -> list[int]:
+        """Meshed device tree-sum: same grouping contract as the inner
+        engine's aggregate_raw_rows, but each group's [L, OUT] partial
+        stays in its shard's HBM and the partials combine with ONE
+        all-reduce over the interconnect instead of bouncing through the
+        host.  Falls back to per-partial host combine (still exact) when
+        the partials don't land one-per-device."""
+        import jax
+
+        e = self.inner
+        if not rows:
+            return e.vdaf.aggregate_init()
+        jax_array = getattr(jax, "Array", ())
+        groups: dict[int, tuple[Any, list[int]]] = {}
+        host_rows: list[Any] = []
+        for r in rows:
+            arr = getattr(r, "array", None)
+            lane = getattr(r, "lane", None)
+            if (arr is not None and lane is not None
+                    and isinstance(arr, jax_array)):
+                groups.setdefault(id(arr), (arr, []))[1].append(lane)
+            else:
+                host_rows.append(r)
+        handles: list[Any] = []
+        from janus_tpu.engine.batch import LaneRef
+
+        for arr, lanes in groups.values():
+            if len(set(lanes)) != len(lanes):
+                host_rows.extend(LaneRef(arr, i) for i in lanes)
+                continue
+            mask = np.zeros(arr.shape[-1], dtype=bool)
+            mask[np.asarray(lanes)] = True
+            # async dispatch on whichever device the batch lives on (the
+            # inputs are committed, so the reduce runs in that shard's HBM)
+            handles.append(e.aggregate_masked_launch(arr, mask))
+        parts: list[list[int]] = []
+        meshed = self._combine_partials(handles)
+        if meshed is not None:
+            parts.append(meshed)
+        else:
+            parts.extend(e.aggregate_resolve(h) for h in handles)
+        if host_rows:
+            parts.append(e._aggregate_host_rows(host_rows))
+        if len(parts) == 1:
+            return parts[0]
+        mod = e.field.MODULUS
+        return [sum(vals) % mod for vals in zip(*parts)]
+
+    def _combine_partials(self, handles: list[Any]) -> list[int] | None:
+        """All-reduce the per-batch partials over the interconnect when
+        they land one-per-device on >= 2 devices; None -> caller resolves
+        each partial through the host (exact either way — modular addition
+        is associative)."""
+        if len(handles) < 2:
+            return None
+        import jax
+
+        from janus_tpu import parallel
+
+        by_dev: dict[Any, list[Any]] = {}
+        for h in handles:
+            try:
+                dev = next(iter(h.devices()))
+            except Exception:
+                return None
+            by_dev.setdefault(dev, []).append(h)
+        if len(by_dev) < 2 or any(len(v) > 1 for v in by_dev.values()):
+            return None
+        pairs = sorted(((d, hs[0]) for d, hs in by_dev.items()),
+                       key=lambda p: getattr(p[0], "id", 0))
+        key = tuple(getattr(d, "id", 0) for d, _ in pairs)
+        with self._partial_lock:
+            entry = self._partial_fns.get(key)
+            if entry is None:
+                m = parallel.report_mesh([d for d, _ in pairs])
+                entry = (m, parallel.partial_reduce_fn(self.inner.f, m))
+                self._partial_fns[key] = entry
+        m, fn = entry
+        shards = [h.reshape(h.shape + (1,)) for _, h in pairs]
+        sharding = parallel.report_sharding(m, axis=2, rank=3)
+        global_shape = shards[0].shape[:2] + (len(pairs),)
+        try:
+            stacked = jax.make_array_from_single_device_arrays(
+                global_shape, sharding, shards)
+            red = fn(stacked)  # replicated [L, OUT]
+            res = np.asarray(red)
+        except Exception as exc:
+            # never let the combine topology fail an aggregate the
+            # host-resolve path can serve exactly
+            trace.warn("meshed partial combine fell back to host resolve",
+                       kind=self._kind, error=str(exc)[:200])
+            return None
+        return self.inner._raw_to_ints(res.T)

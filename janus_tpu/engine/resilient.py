@@ -112,16 +112,27 @@ def raise_if_backend_error(e: BaseException) -> None:
 _chaos_lock = threading.Lock()
 _chaos_active = False
 _chaos_until: float | None = None
+# None = whole-backend poison (every device).  An int scopes the poison to
+# ONE mesh shard index: only that shard's guarded dispatch and probe see
+# the failure, so a meshed engine demotes one shard, not the whole plane.
+_chaos_shard: int | None = None
 
 
-def inject_backend_loss(duration_s: float | None = None) -> None:
+def inject_backend_loss(duration_s: float | None = None,
+                        shard: int | None = None) -> None:
     """Poison the device path: every guarded engine call classifies as a
     backend failure until lift_backend_loss() (or `duration_s` elapses),
     and re-promotion probes fail.  Process-local by design — the
-    inprocess soak and the unit suite share the engines they poison."""
-    global _chaos_active, _chaos_until
+    inprocess soak and the unit suite share the engines they poison.
+
+    With ``shard`` the poison targets a single mesh shard index: only
+    `backend_loss_active(shard=<that index>)` reports the loss, so the
+    whole-engine breaker (which asks without a shard) stays closed and
+    the mesh demotes exactly one device."""
+    global _chaos_active, _chaos_until, _chaos_shard
     with _chaos_lock:
         _chaos_active = True
+        _chaos_shard = shard
         _chaos_until = (time.monotonic() + duration_s
                         if duration_s is not None else None)
 
@@ -129,15 +140,28 @@ def inject_backend_loss(duration_s: float | None = None) -> None:
 def lift_backend_loss() -> None:
     """Heal the injected loss and nudge every demoted engine's probe
     thread so re-promotion doesn't wait out the current backoff."""
-    global _chaos_active, _chaos_until
+    global _chaos_active, _chaos_until, _chaos_shard
     with _chaos_lock:
         _chaos_active = False
         _chaos_until = None
+        _chaos_shard = None
     for eng in _registered_engines():
         eng._breaker.wake.set()
+    try:
+        from janus_tpu.engine import mesh as _mesh
+
+        _mesh.wake_probes()
+    except Exception:  # mesh module optional at teardown
+        pass
 
 
-def backend_loss_active() -> bool:
+def backend_loss_active(shard: int | None = None) -> bool:
+    """Is an injected backend loss live for this caller?
+
+    Whole-backend poison is visible to every caller.  Shard-scoped poison
+    is visible ONLY to a caller asking about that shard — in particular
+    the whole-engine breaker's unscoped query returns False, which is
+    what keeps a one-shard fault from tripping the whole plane."""
     global _chaos_active, _chaos_until
     with _chaos_lock:
         if not _chaos_active:
@@ -146,7 +170,9 @@ def backend_loss_active() -> bool:
             _chaos_active = False
             _chaos_until = None
             return False
-        return True
+        if _chaos_shard is None:
+            return True
+        return shard is not None and shard == _chaos_shard
 
 
 def _chaos_error() -> BackendUnavailable:
@@ -597,7 +623,7 @@ def engines_snapshot() -> list[dict[str, Any]]:
         try:
             b = eng._breaker
             with b.lock:
-                out.append({
+                entry = {
                     "kind": b.kind,
                     "state": b.state,
                     "demoted": b.state != "device",
@@ -612,7 +638,17 @@ def engines_snapshot() -> list[dict[str, Any]]:
                     "last_probe_error": b.last_probe_error,
                     "fallback_count": int(getattr(eng.inner,
                                                   "fallback_count", 0)),
-                })
+                }
+            # per-shard breaker state when a MeshEngine sits in the chain
+            # (engine/mesh.py): the watchdog engines block then shows each
+            # device's demote/probe/re-promote cycle, not just the whole
+            # plane's
+            inner = eng.inner
+            while inner is not None and not hasattr(inner, "shards_snapshot"):
+                inner = getattr(inner, "inner", None)
+            if inner is not None:
+                entry["shards"] = inner.shards_snapshot()
+            out.append(entry)
         except Exception:  # engine mid-teardown; skip
             continue
     return out

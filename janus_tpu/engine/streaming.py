@@ -54,9 +54,15 @@ class LinkBandwidthEstimator:
     """
 
     def __init__(self, alpha: float = 0.3,
-                 min_bytes: int = 262144) -> None:
+                 min_bytes: int = 262144,
+                 device: str | None = None) -> None:
         self._alpha = alpha
         self._min_bytes = min_bytes
+        # Which link this estimator watches: "all" is the process-wide
+        # aggregate (the shared LINK singleton); mesh shards get one
+        # estimator per device so the gauges carry a `device` label and
+        # per-shard chunk plans track per-device link weather.
+        self.device = device or "all"
         self._lock = threading.Lock()
         self._up: float | None = None
         self._down: float | None = None
@@ -72,7 +78,7 @@ class LinkBandwidthEstimator:
             self._up = self._fold(self._up, nbytes / seconds)
             self._observations += 1
             up = self._up
-        metrics.link_up_bytes_per_sec.set(up)
+        metrics.link_up_bytes_per_sec.set(up, device=self.device)
 
     def record_down(self, nbytes: int, seconds: float) -> None:
         if seconds <= 0 or nbytes < self._min_bytes:
@@ -81,7 +87,7 @@ class LinkBandwidthEstimator:
             self._down = self._fold(self._down, nbytes / seconds)
             self._observations += 1
             down = self._down
-        metrics.link_down_bytes_per_sec.set(down)
+        metrics.link_down_bytes_per_sec.set(down, device=self.device)
 
     def seed(self, up_bps: float | None = None,
              down_bps: float | None = None) -> None:
@@ -93,9 +99,11 @@ class LinkBandwidthEstimator:
             if down_bps and down_bps > 0:
                 self._down = self._fold(self._down, float(down_bps))
         if up_bps and up_bps > 0:
-            metrics.link_up_bytes_per_sec.set(float(up_bps))
+            metrics.link_up_bytes_per_sec.set(float(up_bps),
+                                              device=self.device)
         if down_bps and down_bps > 0:
-            metrics.link_down_bytes_per_sec.set(float(down_bps))
+            metrics.link_down_bytes_per_sec.set(float(down_bps),
+                                                device=self.device)
 
     def up_bps(self) -> float | None:
         with self._lock:
@@ -108,6 +116,7 @@ class LinkBandwidthEstimator:
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             return {
+                "device": self.device,
                 "up_bytes_per_sec": round(self._up, 1) if self._up else None,
                 "down_bytes_per_sec": (round(self._down, 1)
                                        if self._down else None),
@@ -178,7 +187,8 @@ def recommend_coalesce_params(
         estimator: LinkBandwidthEstimator | None,
         bytes_per_lane: int,
         default_max_batch: int = 16384,
-        default_delay_ms: float = 4.0) -> tuple[int, float]:
+        default_delay_ms: float = 4.0,
+        shards: int = 1) -> tuple[int, float]:
     """CoalescingEngine operating point for the current link estimate.
 
     `max_batch` targets one launch-upload-budget worth of lanes: a fast
@@ -188,16 +198,23 @@ def recommend_coalesce_params(
     expensive a launch is on this link: when each launch costs hundreds of
     milliseconds of transfer, waiting longer to fill it is nearly free;
     when launches are cheap, a long window only adds latency.
+
+    `shards` is the number of live mesh devices the launch will be split
+    across (engine/mesh.py): each shard stages its slice independently, so
+    the per-launch lane budget scales with the mesh width.
     """
     if estimator is None:
         estimator = LINK
+    shards = max(1, int(shards))
     up = estimator.up_bps()
     if not up or bytes_per_lane <= 0:
-        return default_max_batch, default_delay_ms
-    # lanes whose upload fits the per-chunk budget, snapped to the grid
+        return default_max_batch * shards, default_delay_ms
+    # lanes whose upload fits the per-chunk budget, snapped to the grid;
+    # a mesh multiplies the budget by its live shard count
     lanes = int(up * TARGET_CHUNK_S / bytes_per_lane)
-    max_batch = max(1024, min(65536, _grid_floor(max(lanes, 8))))
+    max_batch = max(1024, min(65536 * shards,
+                              _grid_floor(max(lanes, 8)) * shards))
     # one collection window ~= 1% of the launch upload time, clamped
-    upload_ms = 1000.0 * max_batch * bytes_per_lane / up
+    upload_ms = 1000.0 * max_batch * bytes_per_lane / (up * shards)
     delay_ms = min(16.0, max(1.0, upload_ms / 100.0))
     return max_batch, delay_ms

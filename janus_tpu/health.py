@@ -159,11 +159,25 @@ def _debug_profile(query: dict) -> dict:
         link = streaming.LINK.snapshot()
     except Exception:
         link = None
+    # the meshed data plane: per-shard breaker/link state (engine/mesh.py)
+    # plus the cumulative per-shard launch stats — empty on single-device
+    try:
+        from janus_tpu.engine import mesh as _mesh
+
+        mesh_state = {
+            "engines": _mesh.mesh_snapshot(),
+            "shards": profiler.shards_summary(),
+        }
+        if not mesh_state["engines"] and not mesh_state["shards"]:
+            mesh_state = None
+    except Exception:
+        mesh_state = None
     return {
         "batches": profiler.snapshot(limit=limit),
         "summary": profiler.summary(),
         "engines": engines,
         "link": link,
+        "mesh": mesh_state,
     }
 
 

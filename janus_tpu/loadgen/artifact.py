@@ -124,7 +124,46 @@ def _degraded_analysis(engine_series: list[Any]) -> dict[str, Any]:
     for (svc, kind), t0 in sorted(open_at.items()):
         windows.append({"service": svc, "kind": kind, "demoted_at_s": t0,
                         "repromoted_at_s": None, "duration_s": None})
-    return {
+    # per-shard windows (meshed data plane, engine/mesh.py): a "shards"
+    # list rides in each engine snapshot when the engine serves sharded.
+    # `device_lanes_during` counts the lanes the REST of the mesh served
+    # on device while a shard was down — non-zero is the single-shard
+    # failure-domain proof the shard-loss chaos gate asserts.
+    shard_windows: list[dict[str, Any]] = []
+    shard_open: dict[tuple, list] = {}   # (svc, kind, device) -> [t0, dev0]
+    shard_final: dict[tuple, Any] = {}
+    device_totals: dict[tuple, int] = {}
+    for point in engine_series:
+        t, svc = point["t"], point["service"]
+        for eng in point.get("engines", []):
+            shards = eng.get("shards")
+            if not shards:
+                continue
+            key = (svc, eng.get("kind"))
+            total_dev = sum(s.get("device_lanes", 0) for s in shards)
+            device_totals[key] = total_dev
+            for s in shards:
+                skey = key + (s.get("device"),)
+                shard_final[skey] = s
+                if s.get("demoted"):
+                    shard_open.setdefault(skey, [t, total_dev])
+                elif skey in shard_open:
+                    t0, dev0 = shard_open.pop(skey)
+                    shard_windows.append({
+                        "service": svc, "kind": eng.get("kind"),
+                        "device": s.get("device"),
+                        "demoted_at_s": t0, "repromoted_at_s": t,
+                        "duration_s": round(t - t0, 3),
+                        "device_lanes_during": max(total_dev - dev0, 0)})
+    for skey, (t0, dev0) in sorted(shard_open.items()):
+        svc, kind, device = skey
+        shard_windows.append({
+            "service": svc, "kind": kind, "device": device,
+            "demoted_at_s": t0, "repromoted_at_s": None,
+            "duration_s": None,
+            "device_lanes_during": max(
+                device_totals.get((svc, kind), dev0) - dev0, 0)})
+    out = {
         "windows": windows,
         "demotions": sum(e.get("demotions", 0) for e in final.values()),
         "repromotions": sum(e.get("repromotions", 0)
@@ -135,6 +174,20 @@ def _degraded_analysis(engine_series: list[Any]) -> dict[str, Any]:
         "engines_final": [dict(e, service=svc)
                           for (svc, _kind), e in sorted(final.items())],
     }
+    if shard_final:
+        out["shard_windows"] = shard_windows
+        out["shard_demotions"] = sum(s.get("demotions", 0)
+                                     for s in shard_final.values())
+        out["shard_repromotions"] = sum(s.get("repromotions", 0)
+                                        for s in shard_final.values())
+        out["shard_device_lanes"] = sum(s.get("device_lanes", 0)
+                                        for s in shard_final.values())
+        out["shard_host_lanes"] = sum(s.get("host_lanes", 0)
+                                      for s in shard_final.values())
+        out["shards_final"] = [dict(s, service=svc, kind=kind)
+                               for (svc, kind, _d), s
+                               in sorted(shard_final.items())]
+    return out
 
 
 def build_artifact(*, config: dict[str, Any], generator: Any, scraper: Any,

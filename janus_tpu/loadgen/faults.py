@@ -118,14 +118,23 @@ class BackendLossInjector:
     (bit-identical — the funnel conservation audit must still pass), and
     after ``end_s`` the engines re-promote.  Timer threads, wall-clock
     scheduled relative to ``arm()``.
+
+    With ``shard`` set, the poison is scoped to ONE mesh shard index
+    (engine/mesh.py): only that device's slice of each meshed launch
+    classifies as lost, so the run proves the single-shard failure
+    domain — the targeted shard demotes to the host oracle while the
+    rest of the mesh keeps serving on device (the degraded-window
+    analysis asserts device throughput stays non-zero).
     """
 
-    def __init__(self, start_s: float, end_s: float) -> None:
+    def __init__(self, start_s: float, end_s: float,
+                 shard: int | None = None) -> None:
         if not 0.0 <= start_s < end_s:
             raise ValueError("backend-loss window must satisfy "
                              "0 <= start < end")
         self.start_s = start_s
         self.end_s = end_s
+        self.shard = shard
         self._timers: list["threading.Timer"] = []
         self.injected_at: float | None = None
         self.lifted_at: float | None = None
@@ -140,7 +149,7 @@ class BackendLossInjector:
 
         def poison() -> None:
             self.injected_at = round(time.monotonic() - t0, 3)
-            resilient.inject_backend_loss()
+            resilient.inject_backend_loss(shard=self.shard)
 
         def lift() -> None:
             self.lifted_at = round(time.monotonic() - t0, 3)

@@ -355,10 +355,19 @@ helper_unreachable_total = REGISTRY.counter(
 # host<->device transfer share of each prepare launch
 link_up_bytes_per_sec = REGISTRY.gauge(
     "janus_link_up_bytes_per_sec",
-    "EWMA host->device link bandwidth observed by the prepare data plane")
+    "EWMA host->device link bandwidth observed by the prepare data plane, "
+    "by device ('all' = the process-wide aggregate estimator)")
 link_down_bytes_per_sec = REGISTRY.gauge(
     "janus_link_down_bytes_per_sec",
-    "EWMA device->host link bandwidth observed by the prepare data plane")
+    "EWMA device->host link bandwidth observed by the prepare data plane, "
+    "by device ('all' = the process-wide aggregate estimator)")
+# meshed data plane (engine/mesh.py): reports served per mesh shard, by
+# device and by path (device = sharded kernel, host = that shard's lanes
+# re-served on the bit-identical host oracle while the shard is demoted)
+mesh_shard_reports_total = REGISTRY.counter(
+    "janus_mesh_shard_reports_total",
+    "reports served by the meshed prepare plane, by shard device and path "
+    "(device/host)")
 prepare_transfer_seconds = REGISTRY.histogram(
     "janus_prepare_transfer_seconds",
     "host<->device transfer time per prepare launch (upload of inputs + "

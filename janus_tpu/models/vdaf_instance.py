@@ -202,7 +202,20 @@ def prep_engine(inst: VdafInstance):
                 # kernel input, so mixed-task launches are safe).
                 from janus_tpu.engine.resilient import ResilientEngine
 
-                engine = ResilientEngine(CoalescingEngine(BatchPrio3(vdaf)))
+                base = BatchPrio3(vdaf)
+                # serve sharded across the chip mesh when >1 device (the
+                # meshed data plane, engine/mesh.py); single-device stays
+                # on the plain engine with zero added indirection
+                try:
+                    from janus_tpu.engine.mesh import (MeshEngine,
+                                                       mesh_devices)
+
+                    devs = mesh_devices()
+                    if devs:
+                        base = MeshEngine(base, devices=devs)
+                except Exception:
+                    pass
+                engine = ResilientEngine(CoalescingEngine(base))
             elif inst.kind == "Poplar1":
                 # batched IDPF walk + sketch on device, every level: Field64
                 # inner walk/sketch and the Field255 leaf (ops/field255.py)

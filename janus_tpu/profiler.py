@@ -39,6 +39,9 @@ _padding: dict[str, list] = {}
 # kind -> [transfer_s_total, device_s_total] so /debug/profile can show the
 # transfer-vs-compute split of the streaming data plane per engine kind
 _phase_totals: dict[str, list] = {}
+# (device, kind) -> [launches, reports, transfer_s, chunks] for the meshed
+# data plane (engine/mesh.py): per-shard occupancy of the serving plane
+_shard_totals: dict[tuple, list] = {}
 
 
 def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
@@ -108,6 +111,38 @@ def record_batch(kind: str, vdaf: str, bucket: int, reports: int,
         metrics.device_batch_compiles.add(1, kind=kind, bucket=str(bucket))
 
 
+def record_shard(device: str, kind: str, reports: int,
+                 transfer_s: float = 0.0, chunks: int = 1) -> None:
+    """Record one shard's slice of a meshed launch (engine/mesh.py).
+
+    device: shard label ("cpu:3", "tpu:0"); kind: entry point as in
+    record_batch; chunks: double-buffered upload chunks this slice used.
+    Cumulative per-shard totals surface in the /debug/profile "shards"
+    section so an unbalanced or cold shard is visible at a glance.
+    """
+    with _lock:
+        tot = _shard_totals.setdefault((device, kind), [0, 0, 0.0, 0])
+        tot[0] += 1
+        tot[1] += int(reports)
+        tot[2] += transfer_s
+        tot[3] += int(chunks)
+
+
+def shards_summary() -> dict:
+    """Cumulative per-(device, kind) meshed-launch stats for
+    /debug/profile; empty when the mesh plane never sharded a launch."""
+    with _lock:
+        out: dict = {}
+        for (device, kind), tot in sorted(_shard_totals.items()):
+            out.setdefault(device, {})[kind] = {
+                "launches": tot[0],
+                "reports": tot[1],
+                "transfer_s": round(tot[2], 6),
+                "chunks": tot[3],
+            }
+        return out
+
+
 def snapshot(limit: int | None = None) -> list[dict]:
     """Most recent batch records, oldest first."""
     with _lock:
@@ -143,3 +178,4 @@ def clear() -> None:
         _records.clear()
         _padding.clear()
         _phase_totals.clear()
+        _shard_totals.clear()

@@ -116,6 +116,11 @@ def parse_args(argv=None):
                          "BackendLossInjector): engines demote to the "
                          "host oracle, then re-promote after b — the "
                          "artifact's degraded section records the cycle")
+    ap.add_argument("--loss-shard", type=int, default=None,
+                    help="scope --backend-loss to ONE mesh shard index "
+                         "(engine/mesh.py): only that device demotes to "
+                         "the host oracle while the rest of the mesh "
+                         "keeps serving on device")
     ap.add_argument("--scrape-interval", type=float, default=None,
                     help="telemetry poll period (default: duration/60, "
                          "clamped to [0.5, 5])")
@@ -602,8 +607,10 @@ def main(argv=None) -> int:
             lo, hi = _fault_window(args.backend_loss)
             backend_loss = BackendLossInjector(
                 max(lo * args.duration, 0.001),
-                hi * args.duration).arm()
-            log(f"backend-loss armed: device poison "
+                hi * args.duration, shard=args.loss_shard).arm()
+            scope = ("all engines" if args.loss_shard is None
+                     else f"mesh shard {args.loss_shard}")
+            log(f"backend-loss armed: device poison ({scope}) "
                 f"+{backend_loss.start_s:.1f}s .. "
                 f"+{backend_loss.end_s:.1f}s into the load")
         run_start = time.time()
@@ -654,6 +661,7 @@ def main(argv=None) -> int:
                 "seed": args.seed, "workers": args.workers,
                 "job_size": args.job_size, "top_up_reports": fillers,
                 "backend_loss": args.backend_loss,
+                "loss_shard": args.loss_shard,
             },
             generator=generator, scraper=scraper, audit=audit,
             acceptance_objective=float(os.environ.get(

@@ -1,0 +1,143 @@
+"""8-device mesh data-plane proofs (driven by tests/test_mesh.py).
+
+Run in a SUBPROCESS so the forced host-device count and the chaos
+poison (process-global) cannot leak into the rest of the suite.  Env
+contract (set by the driver): XLA_FLAGS forces >= 8 host devices,
+JAX_PLATFORMS=cpu, JANUS_MESH=1, JANUS_MESH_MIN_SHARD small enough that
+the proof batch shards across all devices, fast JANUS_ENGINE_PROBE_*.
+
+Three proofs, one process (jax imports once):
+  A. sharded prepare is byte-identical to the single-device engine AND
+     the per-lane host oracle, including tampered lanes (bad input
+     share, bad leader prep share);
+  B. killing one shard (shard-scoped chaos) demotes ONLY that shard —
+     the observing call re-serves its lanes on the host oracle with
+     every report conserved, the next call plans around it, and the
+     probe re-promotes after the poison lifts;
+  C. the all-reduced meshed aggregate equals the host fold exactly.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+assert len(jax.devices()) >= 8, jax.devices()
+
+from janus_tpu.engine import BatchPrio3, resilient  # noqa: E402
+from janus_tpu.engine.mesh import MeshEngine  # noqa: E402
+from janus_tpu.vdaf import ping_pong, prio3  # noqa: E402
+from janus_tpu.vdaf.transcript import run_vdaf  # noqa: E402
+
+TYPE_INITIALIZE = ping_pong.PingPongMessage.TYPE_INITIALIZE
+
+rng = np.random.default_rng(7)
+vdaf = prio3.new_count()
+vk = rng.bytes(vdaf.VERIFY_KEY_SIZE)
+N = 48
+
+transcripts = [
+    run_vdaf(vdaf, vk, int(m), nonce=rng.bytes(16),
+             rand=rng.bytes(vdaf.RAND_SIZE))
+    for m in rng.integers(0, 2, N)
+]
+nonces = [t.nonce for t in transcripts]
+pubs = [t.encoded_public_share for t in transcripts]
+shares = [t.encoded_input_shares[1] for t in transcripts]
+inbound = [
+    ping_pong.PingPongMessage(TYPE_INITIALIZE,
+                              prep_share=t.encoded_prep_shares[0])
+    for t in transcripts
+]
+# tampered lanes: a corrupt helper input share (lane 5) and a corrupt
+# leader prep share (lane 11) must fail per-lane on EVERY path
+shares[5] = shares[5][:-1] + bytes([shares[5][-1] ^ 1])
+bad_ps = transcripts[11].encoded_prep_shares[0]
+bad_ps = bad_ps[:-1] + bytes([bad_ps[-1] ^ 1])
+inbound[11] = ping_pong.PingPongMessage(TYPE_INITIALIZE, prep_share=bad_ps)
+
+
+def canon(engine, reps):
+    out = []
+    for r in reps:
+        outb = (None if r.outbound is None else
+                (r.outbound.type, r.outbound.prep_share,
+                 r.outbound.prep_msg))
+        share = (None if r.out_share_raw is None else
+                 engine._raw_to_ints(r.out_share_raw))
+        out.append((r.status, outb, r.prep_share, share))
+    return out
+
+
+single = BatchPrio3(vdaf)
+mesh = MeshEngine(BatchPrio3(vdaf), devices=jax.devices()[:8])
+
+want = canon(single, single.helper_init_batch(vk, nonces, pubs, shares,
+                                              inbound))
+oracle = canon(single, [
+    single._host_helper(vk, nonces[i], pubs[i], shares[i], inbound[i])
+    for i in range(N)
+])
+out_mesh = mesh.helper_init_batch(vk, nonces, pubs, shares, inbound)
+got = canon(mesh, out_mesh)
+
+assert want == oracle, "single-device engine disagrees with host oracle"
+assert got == want, "meshed prepare disagrees with single-device engine"
+statuses = {r.status for r in out_mesh}
+assert "finished" in statuses and "failed" in statuses, statuses
+snap = mesh.shards_snapshot()
+assert all(s["device_lanes"] == N // 8 for s in snap), snap
+print("PROOF A OK: sharded prepare byte-identical "
+      f"({len(snap)} shards x {N // 8} lanes, tampered lanes failed)")
+
+# -- B: single-shard failure domain ------------------------------------
+
+DEAD = 3
+resilient.inject_backend_loss(shard=DEAD)
+try:
+    out_loss = mesh.helper_init_batch(vk, nonces, pubs, shares, inbound)
+    assert canon(mesh, out_loss) == want, \
+        "reports lost or changed during shard loss"
+    snap = mesh.shards_snapshot()
+    assert snap[DEAD]["demoted"] and snap[DEAD]["demotions"] == 1, snap[DEAD]
+    assert snap[DEAD]["host_lanes"] == N // 8, snap[DEAD]
+    assert all(not s["demoted"] for i, s in enumerate(snap) if i != DEAD)
+    # the next launch plans AROUND the dead shard: all lanes on device
+    before = sum(s["device_lanes"] for s in snap)
+    out_replan = mesh.helper_init_batch(vk, nonces, pubs, shares, inbound)
+    assert canon(mesh, out_replan) == want
+    snap = mesh.shards_snapshot()
+    assert snap[DEAD]["host_lanes"] == N // 8, "dead shard served again"
+    assert sum(s["device_lanes"] for s in snap) == before + N, \
+        "live mesh did not absorb the dead shard's lanes"
+finally:
+    resilient.lift_backend_loss()
+
+deadline = time.monotonic() + 30.0
+while mesh.shards_snapshot()[DEAD]["demoted"]:
+    if time.monotonic() > deadline:
+        sys.exit("shard never re-promoted after the poison lifted")
+    time.sleep(0.05)
+assert mesh.shards_snapshot()[DEAD]["repromotions"] == 1
+print("PROOF B OK: single-shard demote/conserve/replan/re-promote")
+
+# -- C: all-reduced aggregate == host fold -----------------------------
+
+rows = [r.out_share_raw for r in out_mesh if r.status == "finished"]
+assert len(rows) == N - 2, len(rows)
+meshed_agg = mesh.aggregate_raw_rows(rows)
+host_fold = mesh.inner._aggregate_host_rows(rows)
+assert meshed_agg == host_fold, "all-reduced aggregate != host fold"
+assert mesh._partial_fns, "combine did not take the all-reduce path"
+single_agg = single.aggregate(
+    single.helper_init_batch(vk, nonces, pubs, shares, inbound))
+assert meshed_agg == single_agg
+print("PROOF C OK: interconnect all-reduce aggregate exact")
+
+print("ALL MESH PROOFS PASSED")

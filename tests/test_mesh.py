@@ -1,0 +1,101 @@
+"""Meshed data plane (engine/mesh.py): planner units in-process, the
+8-device serve/failure/aggregate proofs in a subprocess.
+
+The proofs run tests/mesh_proof.py in a child so the forced host-device
+count and the chaos poison (process-global state) cannot leak into the
+rest of the suite; one child covers all three proofs so jax imports
+once."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from janus_tpu.engine import streaming  # noqa: E402
+from janus_tpu.engine.mesh import MeshEngine, mesh_devices  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh_engine(min_shard=4):
+    from janus_tpu.engine import BatchPrio3
+    from janus_tpu.vdaf import prio3
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("mesh planner tests need >= 2 devices")
+    os.environ["JANUS_MESH_MIN_SHARD"] = str(min_shard)
+    try:
+        return MeshEngine(BatchPrio3(prio3.new_count()), devices=devs)
+    finally:
+        del os.environ["JANUS_MESH_MIN_SHARD"]
+
+
+def test_plan_partitions_every_lane():
+    eng = _mesh_engine(min_shard=4)
+    n = 4 * len(eng._shards) + 3  # uneven on purpose
+    plan = eng.plan(n, "helper")
+    assert plan is not None
+    assert [ps.index for ps in plan.shards] == sorted(
+        ps.index for ps in plan.shards)
+    covered = []
+    for ps in plan.shards:
+        covered.extend(range(ps.start, ps.start + ps.count))
+        assert ps.bucket >= ps.count
+    assert covered == list(range(n)), "plan must cover lanes exactly once"
+
+
+def test_plan_small_launch_delegates():
+    eng = _mesh_engine(min_shard=4)
+    assert eng.plan(7, "helper") is None  # < 2 shards worth of lanes
+
+
+def test_plan_skips_demoted_shards():
+    eng = _mesh_engine(min_shard=4)
+    eng._shards[0].state = "host"
+    try:
+        plan = eng.plan(4 * len(eng._shards), "helper")
+        assert plan is not None
+        assert 0 not in [ps.index for ps in plan.shards]
+        assert eng.live_shards == len(eng._shards) - 1
+    finally:
+        eng._shards[0].state = "device"
+
+
+def test_recommend_coalesce_params_scales_with_shards():
+    est = streaming.LinkBandwidthEstimator(device="test:0")
+    est.seed(1e9, 1e9)
+    lane = 4096
+    mb1, _ = streaming.recommend_coalesce_params(est, lane, shards=1)
+    mb4, _ = streaming.recommend_coalesce_params(est, lane, shards=4)
+    assert mb4 == min(4 * mb1, 65536 * 4)
+
+
+def test_mesh_devices_off_switch(monkeypatch):
+    monkeypatch.setenv("JANUS_MESH", "0")
+    assert mesh_devices() is None
+
+
+def test_mesh_proofs_subprocess():
+    """Proofs A-C from tests/mesh_proof.py on a forced 8-device mesh."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            p for p in (REPO, os.environ.get("PYTHONPATH")) if p),
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        JAX_PLATFORMS="cpu",
+        JANUS_MESH="1",
+        JANUS_MESH_MIN_SHARD="4",
+        JANUS_ENGINE_PROBE_INITIAL_S="0.05",
+        JANUS_ENGINE_PROBE_MAX_S="0.2",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "mesh_proof.py")],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"mesh proofs exited {proc.returncode}\n"
+        f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-3000:]}")
+    assert "ALL MESH PROOFS PASSED" in proc.stdout
